@@ -1,0 +1,65 @@
+"""distributed_tpu — a TPU-native distributed training framework.
+
+Capability parity with the reference system (Mrhs121/distributed: TF 2.0
+MultiWorkerMirroredStrategy over TF_CONFIG/gRPC, driven from R, Python and
+Spark — see SURVEY.md), re-designed for TPU: jit-compiled train steps,
+device meshes + NamedSharding for parallelism, XLA collectives over ICI/DCN,
+`jax.distributed` for multi-host bootstrap.
+
+Quickstart (the reference's local->distributed 6-line-diff contract):
+
+    import distributed_tpu as dtpu
+
+    x, y = dtpu.data.load_mnist("train")
+    model = dtpu.Model(dtpu.models.mnist_cnn())
+    model.compile(optimizer=dtpu.optim.SGD(0.001),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=64, epochs=3)
+
+    # distributed: wrap construction in a strategy scope
+    strategy = dtpu.DataParallel()
+    with strategy.scope():
+        model = dtpu.Model(dtpu.models.mnist_cnn())
+        model.compile(...)
+    model.fit(x, y, batch_size=64 * strategy.num_replicas_in_sync, epochs=3)
+"""
+
+from . import cluster, data, models, nn, ops, optim, parallel
+from .checkpoint import Checkpointer, export_hdf5, import_hdf5
+from .ops import losses, metrics
+from .parallel.mesh import make_mesh
+from .parallel.strategy import (
+    DataParallel,
+    MultiWorkerMirroredStrategy,
+    SingleDevice,
+    Strategy,
+    current_strategy,
+)
+from .training.history import History
+from .training.model import Model
+from .version import __version__
+
+__all__ = [
+    "Model",
+    "History",
+    "Strategy",
+    "SingleDevice",
+    "DataParallel",
+    "MultiWorkerMirroredStrategy",
+    "current_strategy",
+    "make_mesh",
+    "Checkpointer",
+    "export_hdf5",
+    "import_hdf5",
+    "nn",
+    "ops",
+    "optim",
+    "losses",
+    "metrics",
+    "models",
+    "data",
+    "parallel",
+    "cluster",
+    "__version__",
+]

@@ -1,0 +1,19 @@
+from .core import (
+    Checkpointer,
+    artifact_decode,
+    artifact_encode,
+    export_hdf5,
+    import_hdf5,
+    load_npz,
+    save_npz,
+)
+
+__all__ = [
+    "Checkpointer",
+    "save_npz",
+    "load_npz",
+    "export_hdf5",
+    "import_hdf5",
+    "artifact_encode",
+    "artifact_decode",
+]

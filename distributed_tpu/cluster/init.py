@@ -1,0 +1,103 @@
+"""Multi-host runtime bootstrap.
+
+Replaces the reference's cluster handshake — TF_CONFIG parsed at strategy
+construction, per-worker gRPC servers, blocking collective handshake at first
+fit() (/root/reference/README.md:395-399) — with ``jax.distributed``: every
+host runs the same SPMD program, process 0 hosts the coordinator service, and
+all collectives are XLA-compiled over ICI/DCN (no gRPC worker in the loop).
+
+``initialize()`` is idempotent and resolution-ordered (explicit spec >
+DTPU_CONFIG/TF_CONFIG env > TPU runtime auto-detect > single-process no-op),
+mirroring the reference's config-by-environment contract (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..utils import logging as dlog
+from . import config as config_lib
+
+_initialized = False
+
+
+def initialize(
+    spec: Optional[config_lib.ClusterSpec] = None,
+    *,
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> config_lib.ClusterSpec:
+    """Join (or form) the cluster. Call once, before any device computation —
+    the same ordering constraint the reference enforces by requiring a fresh
+    session before setting TF_CONFIG (/root/reference/README.md:316-317).
+
+    Returns the resolved ClusterSpec (a synthetic one under auto-detect).
+    """
+    global _initialized
+    if coordinator is not None:
+        spec = config_lib.ClusterSpec(
+            workers=[coordinator] + [f"?:{i}" for i in range(1, num_processes or 1)],
+            index=process_id or 0,
+        )
+        if num_processes and num_processes > 1 and not _initialized:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            _initialized = True
+        return spec
+
+    spec = config_lib.resolve(spec)
+    if spec is not None and spec.num_processes > 1:
+        if not _initialized:
+            jax.distributed.initialize(
+                coordinator_address=spec.coordinator,
+                num_processes=spec.num_processes,
+                process_id=spec.index,
+            )
+            _initialized = True
+            if spec.is_chief:
+                dlog.info(
+                    f"cluster up: {spec.num_processes} processes, "
+                    f"coordinator {spec.coordinator}, "
+                    f"{jax.device_count()} devices total"
+                )
+        return spec
+    # Auto-detect path: on a real TPU pod slice each host sees its local chips
+    # and jax.distributed.initialize() with no args uses the TPU metadata.
+    if os.environ.get("DTPU_AUTO_INIT") == "1" and not _initialized:
+        jax.distributed.initialize()
+        _initialized = True
+    return config_lib.ClusterSpec(
+        workers=[f"localhost:0"], index=0
+    )
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier", timeout_s: int = 600):
+    """Host-level sync point (the reference gets this implicitly from its
+    first collective, README.md:399; we expose it explicitly)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

@@ -1,0 +1,61 @@
+"""Network helpers: IP discovery and reachability preflight.
+
+Parity targets: the reference's IP helper
+(/root/reference/README.md:271-275: ``socket.gethostbyname(socket.gethostname())``)
+and its manual ``ping <ip>`` preflight advice (README.md:251), turned into a
+programmatic TCP check the launcher runs before gang-start.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Tuple
+
+
+def my_ip() -> str:
+    """Best-effort local IP (README.md:271-275 equivalent, with a UDP-connect
+    fallback that works when the hostname doesn't resolve)."""
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))  # no packets sent; just picks a route
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def check_reachable(addr: str, timeout: float = 2.0) -> bool:
+    """TCP reachability to host:port (the programmatic 'ping', README.md:251).
+
+    A connection *refusal* still means the host is up (nothing bound to the
+    port yet — normal before gang-start); only DNS failure or a timeout /
+    network unreachability counts as down."""
+    host, port = addr.rsplit(":", 1)
+    try:
+        with socket.create_connection((host, int(port)), timeout=timeout):
+            return True
+    except ConnectionRefusedError:
+        return True  # host answered; port simply not bound yet
+    except OSError:
+        return False
+
+
+def preflight(workers: List[str], timeout: float = 2.0) -> Dict[str, bool]:
+    """Reachability map for a worker list, run by the launcher before
+    gang-start (replaces the reference's manual `ping`, README.md:251)."""
+    return {w: check_reachable(w, timeout=timeout) for w in workers}

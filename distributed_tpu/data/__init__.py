@@ -1,0 +1,9 @@
+from .datasets import load, load_cifar10, load_fashion_mnist, load_mnist, synthetic_images
+
+__all__ = [
+    "load",
+    "load_mnist",
+    "load_fashion_mnist",
+    "load_cifar10",
+    "synthetic_images",
+]

@@ -1,0 +1,3 @@
+from .cnn import cifar_cnn, mnist_cnn
+
+__all__ = ["mnist_cnn", "cifar_cnn"]

@@ -1,0 +1,42 @@
+"""The reference model zoo: small CNNs.
+
+``mnist_cnn`` is the exact architecture of the reference's trainers
+(/root/reference/README.md:58-68 R form, 292-298 Python form):
+Conv2D(32, 3x3, relu) -> MaxPool2D -> Flatten -> Dense(64, relu) -> Dense(10)
+= 347,146 params in 6 tensors (BASELINE.md model-size row).
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+
+def mnist_cnn(num_classes: int = 10, dtype=None) -> nn.Sequential:
+    return nn.Sequential(
+        [
+            nn.Conv2D(32, (3, 3), activation="relu", dtype=dtype),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu", dtype=dtype),
+            nn.Dense(num_classes, dtype=dtype),
+        ]
+    )
+
+
+def cifar_cnn(num_classes: int = 10, dtype=None) -> nn.Sequential:
+    """A deeper small CNN for CIFAR-10 / Fashion-MNIST scale (BASELINE.json
+    configs[2]); VGG-ish 3-block stack sized to train quickly on one chip."""
+    return nn.Sequential(
+        [
+            nn.Conv2D(64, (3, 3), padding="same", activation="relu", dtype=dtype),
+            nn.Conv2D(64, (3, 3), padding="same", activation="relu", dtype=dtype),
+            nn.MaxPool2D(2),
+            nn.Conv2D(128, (3, 3), padding="same", activation="relu", dtype=dtype),
+            nn.Conv2D(128, (3, 3), padding="same", activation="relu", dtype=dtype),
+            nn.MaxPool2D(2),
+            nn.Conv2D(256, (3, 3), padding="same", activation="relu", dtype=dtype),
+            nn.GlobalAvgPool2D(),
+            nn.Dense(256, activation="relu", dtype=dtype),
+            nn.Dense(num_classes, dtype=dtype),
+        ]
+    )

@@ -1,0 +1,31 @@
+from .core import Lambda, Layer, Sequential
+from .layers import (
+    Activation,
+    AvgPool2D,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    Flatten,
+    GlobalAvgPool2D,
+    LayerNorm,
+    MaxPool2D,
+)
+
+__all__ = [
+    "Layer",
+    "Sequential",
+    "Lambda",
+    "Conv2D",
+    "Dense",
+    "Flatten",
+    "Activation",
+    "MaxPool2D",
+    "AvgPool2D",
+    "GlobalAvgPool2D",
+    "BatchNorm",
+    "LayerNorm",
+    "Dropout",
+    "Embedding",
+]

@@ -1,0 +1,29 @@
+"""Activation registry (Keras-style names -> jax.nn functions)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": jax.nn.softmax,
+    "linear": lambda x: x,
+    None: lambda x: x,
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {name_or_fn!r}; known: {sorted(k for k in _REGISTRY if k)}"
+        ) from None

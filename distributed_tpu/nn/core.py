@@ -1,0 +1,184 @@
+"""Functional layer system.
+
+Design notes (TPU-first):
+
+- A Layer is a *pure description*: construction stores hyperparameters only.
+  Parameters live in plain nested-dict pytrees created by ``init`` and are
+  threaded explicitly through ``apply``. This is the JAX idiom (init/apply)
+  rather than the reference's object-holding-variables Keras idiom
+  (/root/reference/README.md:292-298), and is what makes a whole train step
+  jit-compilable and shardable with ``NamedSharding`` over a device mesh.
+- ``apply`` is side-effect free: mutable layer state (e.g. BatchNorm running
+  stats) is returned, never written in place, so XLA sees static dataflow.
+- Shapes are static: ``init`` takes the (batch-free) input shape and performs
+  shape inference once, in Python, outside any trace.
+
+The public surface still *reads* like the reference's Keras Sequential UX
+(/root/reference/README.md:58-68): ``Sequential([Conv2D(...), Flatten(),
+Dense(...)])``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+Shape = Tuple[int, ...]
+
+
+def _camel_to_snake(name: str) -> str:
+    # Conv2D -> conv2d, MaxPool2D -> max_pool2d (split only at lower->Upper).
+    return re.sub(r"(?<=[a-z])(?=[A-Z])", "_", name).lower()
+
+
+class Layer:
+    """Base class: hyperparameters in, pure init/apply out."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name  # finalized by the enclosing container (or init())
+        self._name_explicit = name is not None
+
+    # -- to be overridden ---------------------------------------------------
+    def init(self, key: jax.Array, input_shape: Shape) -> Tuple[Params, State, Shape]:
+        """Create (params, state, output_shape) for a given unbatched input shape."""
+        raise NotImplementedError
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x,
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Run the layer on a batched input. Returns (output, new_state)."""
+        raise NotImplementedError
+
+    # -- shared helpers -----------------------------------------------------
+    def default_name(self) -> str:
+        return _camel_to_snake(type(self).__name__)
+
+    def param_spec(self, input_shape: Shape) -> Dict[str, Shape]:
+        """Shapes of this layer's parameters (used for sharding rules); optional."""
+        return {}
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name!r})"
+
+
+class NameScope:
+    """Assigns unique keras-style names ('conv2d', 'conv2d_1', ...) within a container."""
+
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+        self._used = set()
+
+    def assign(self, layer: Layer) -> str:
+        if layer._name_explicit and layer.name:
+            if layer.name in self._used:
+                raise ValueError(f"Duplicate layer name {layer.name!r}")
+            self._used.add(layer.name)
+            return layer.name
+        base = layer.default_name()
+        n = self._counts.get(base, 0)
+        self._counts[base] = n + 1
+        name = base if n == 0 else f"{base}_{n}"
+        self._used.add(name)
+        return name
+
+
+class Sequential(Layer):
+    """Linear stack of layers; itself a Layer, so stacks compose.
+
+    Parity target: ``keras_model_sequential() %>% layer_conv_2d(...) %>% ...``
+    (/root/reference/README.md:58-68) and ``tf.keras.Sequential([...])``
+    (/root/reference/README.md:292-298).
+    """
+
+    def __init__(self, layers: Sequence[Layer], name: Optional[str] = None):
+        super().__init__(name)
+        self.layers = list(layers)
+        scope = NameScope()
+        for layer in self.layers:
+            layer.name = scope.assign(layer)
+
+    def add(self, layer: Layer):
+        scope = NameScope()
+        for existing in self.layers:
+            scope._used.add(existing.name)
+            m = re.fullmatch(r"(.+?)(?:_(\d+))?", existing.name)
+            base = m.group(1) if m else existing.name
+            idx = int(m.group(2)) + 1 if m and m.group(2) else 1
+            scope._counts[base] = max(scope._counts.get(base, 0), idx)
+        layer.name = scope.assign(layer)
+        self.layers.append(layer)
+
+    def init(self, key, input_shape):
+        params: Params = {}
+        state: State = {}
+        shape = tuple(input_shape)
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        for layer, k in zip(self.layers, keys):
+            p, s, shape = layer.init(k, shape)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+        return params, state, shape
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        new_state: State = {}
+        n_rng = sum(1 for l in self.layers if getattr(l, "needs_rng", False))
+        rngs = iter(jax.random.split(rng, n_rng)) if (rng is not None and n_rng) else iter(())
+        for layer in self.layers:
+            layer_rng = next(rngs, None) if getattr(layer, "needs_rng", False) else None
+            x, s = layer.apply(
+                params.get(layer.name, {}),
+                state.get(layer.name, {}),
+                x,
+                train=train,
+                rng=layer_rng,
+            )
+            if s:
+                new_state[layer.name] = s
+        return x, new_state
+
+    def summary_lines(self, input_shape: Shape):
+        """Keras-style summary rows: (name, output_shape, param_count)."""
+        from ..utils.tree import tree_size
+
+        rows = []
+        key = jax.random.PRNGKey(0)
+        shape = tuple(input_shape)
+        for layer in self.layers:
+            p, _, shape = layer.init(key, shape)
+            rows.append((layer.name, (None,) + shape, tree_size(p)))
+        return rows
+
+    def __repr__(self):
+        inner = ", ".join(repr(l) for l in self.layers)
+        return f"Sequential([{inner}])"
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary stateless function ``fn(x) -> y``."""
+
+    def __init__(self, fn, output_shape=None, name=None):
+        super().__init__(name)
+        self.fn = fn
+        self._output_shape = output_shape
+
+    def init(self, key, input_shape):
+        if self._output_shape is not None:
+            out = tuple(self._output_shape)
+        else:
+            out = jax.eval_shape(self.fn, jax.ShapeDtypeStruct((1,) + tuple(input_shape), "float32")).shape[1:]
+        return {}, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.fn(x), {}

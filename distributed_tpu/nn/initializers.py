@@ -1,0 +1,38 @@
+"""Weight initializers (TPU-friendly: everything is a pure function of a PRNG key).
+
+Mirrors the initializer surface the reference's Keras layers rely on
+(glorot_uniform default for Conv2D/Dense — /root/reference/README.md:292-298),
+implemented as thin wrappers over jax.nn.initializers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_REGISTRY = {
+    "glorot_uniform": jax.nn.initializers.glorot_uniform,
+    "glorot_normal": jax.nn.initializers.glorot_normal,
+    "he_uniform": jax.nn.initializers.he_uniform,
+    "he_normal": jax.nn.initializers.he_normal,
+    "lecun_normal": jax.nn.initializers.lecun_normal,
+    "zeros": lambda: jax.nn.initializers.zeros,
+    "ones": lambda: jax.nn.initializers.ones,
+}
+
+
+def get(name_or_fn, dtype=jnp.float32):
+    """Resolve an initializer by Keras-style name or pass a callable through."""
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        factory = _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(
+            f"Unknown initializer {name_or_fn!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+    return factory()
+
+
+def normal(stddev=0.01):
+    return jax.nn.initializers.normal(stddev)

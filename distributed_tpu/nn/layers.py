@@ -1,0 +1,317 @@
+"""Standard layers, NHWC, MXU-friendly.
+
+Covers the layer surface the reference's scripts use — Conv2D / Flatten /
+Dense with relu (/root/reference/README.md:58-68, 292-298) — plus the layers
+the wider model zoo (ResNet-50, Transformer) needs.
+
+TPU notes:
+- Convs/matmuls go through ``lax.conv_general_dilated`` / ``jnp.dot`` so XLA
+  tiles them onto the MXU; ``dtype`` selects the compute precision (bfloat16
+  recommended) while parameters stay float32.
+- All layers are shape-static and trace-free of Python control flow, so the
+  whole model jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import activations, initializers
+from .core import Layer, Shape
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _conv_out(size: int, k: int, s: int, padding: str) -> int:
+    if padding.upper() == "SAME":
+        return -(-size // s)
+    return (size - k) // s + 1
+
+
+class Conv2D(Layer):
+    """2-D convolution over NHWC inputs (kernel laid out HWIO for XLA)."""
+
+    def __init__(
+        self,
+        filters: int,
+        kernel_size: IntOr2,
+        strides: IntOr2 = 1,
+        padding: str = "valid",
+        activation=None,
+        use_bias: bool = True,
+        kernel_initializer="glorot_uniform",
+        dtype=None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.filters = int(filters)
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding.upper()
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.dtype = dtype
+
+    def init(self, key, input_shape: Shape):
+        h, w, cin = input_shape
+        kh, kw = self.kernel_size
+        kernel = initializers.get(self.kernel_initializer)(
+            key, (kh, kw, cin, self.filters), jnp.float32
+        )
+        params = {"kernel": kernel}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), jnp.float32)
+        out = (
+            _conv_out(h, kh, self.strides[0], self.padding),
+            _conv_out(w, kw, self.strides[1], self.padding),
+            self.filters,
+        )
+        return params, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"]
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+        y = lax.conv_general_dilated(
+            x,
+            kernel,
+            window_strides=self.strides,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), {}
+
+
+class Dense(Layer):
+    """Affine map on the trailing axis; works for (B, D) and (B, T, D) alike."""
+
+    def __init__(
+        self,
+        units: int,
+        activation=None,
+        use_bias: bool = True,
+        kernel_initializer="glorot_uniform",
+        dtype=None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.units = int(units)
+        self.activation = activations.get(activation)
+        self.use_bias = use_bias
+        self.kernel_initializer = kernel_initializer
+        self.dtype = dtype
+
+    def init(self, key, input_shape: Shape):
+        din = input_shape[-1]
+        kernel = initializers.get(self.kernel_initializer)(
+            key, (din, self.units), jnp.float32
+        )
+        params = {"kernel": kernel}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), jnp.float32)
+        return params, {}, tuple(input_shape[:-1]) + (self.units,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        kernel = params["kernel"]
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+            kernel = kernel.astype(self.dtype)
+        y = jnp.dot(x, kernel)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return self.activation(y), {}
+
+
+class Flatten(Layer):
+    def init(self, key, input_shape: Shape):
+        out = 1
+        for d in input_shape:
+            out *= d
+        return {}, {}, (out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return x.reshape((x.shape[0], -1)), {}
+
+
+class Activation(Layer):
+    def __init__(self, activation, name=None):
+        super().__init__(name)
+        self.fn = activations.get(activation)
+
+    def init(self, key, input_shape):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self.fn(x), {}
+
+
+class _Pool2D(Layer):
+    def __init__(self, pool_size: IntOr2 = 2, strides: Optional[IntOr2] = None, padding="valid", name=None):
+        super().__init__(name)
+        self.pool_size = _pair(pool_size)
+        self.strides = _pair(strides) if strides is not None else self.pool_size
+        self.padding = padding.upper()
+
+    def init(self, key, input_shape: Shape):
+        h, w, c = input_shape
+        out = (
+            _conv_out(h, self.pool_size[0], self.strides[0], self.padding),
+            _conv_out(w, self.pool_size[1], self.strides[1], self.padding),
+            c,
+        )
+        return {}, {}, out
+
+    def _reduce(self, x):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return self._reduce(x), {}
+
+
+class MaxPool2D(_Pool2D):
+    def _reduce(self, x):
+        return lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,),
+            padding=self.padding,
+        )
+
+
+class AvgPool2D(_Pool2D):
+    def _reduce(self, x):
+        ones = lax.reduce_window(
+            jnp.ones_like(x),
+            0.0,
+            lax.add,
+            window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,),
+            padding=self.padding,
+        )
+        summed = lax.reduce_window(
+            x,
+            0.0,
+            lax.add,
+            window_dimensions=(1,) + self.pool_size + (1,),
+            window_strides=(1,) + self.strides + (1,),
+            padding=self.padding,
+        )
+        return summed / ones
+
+
+class GlobalAvgPool2D(Layer):
+    def init(self, key, input_shape: Shape):
+        return {}, {}, (input_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), {}
+
+
+class Dropout(Layer):
+    needs_rng = True
+
+    def __init__(self, rate: float, name=None):
+        super().__init__(name)
+        self.rate = float(rate)
+
+    def init(self, key, input_shape):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train or self.rate == 0.0:
+            return x, {}
+        if rng is None:
+            raise ValueError("Dropout needs an rng when train=True")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), {}
+
+
+class BatchNorm(Layer):
+    """Batch normalization over all but the channel (last) axis.
+
+    Under data parallelism the batch axis is sharded across the mesh; because
+    the stats are plain ``jnp.mean`` reductions inside the jitted step, XLA
+    lowers them to cross-replica collectives automatically — i.e. this is
+    sync-BN by construction, no separate "SyncBatchNorm" needed.
+    """
+
+    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5, name=None):
+        super().__init__(name)
+        self.momentum = float(momentum)
+        self.epsilon = float(epsilon)
+
+    def init(self, key, input_shape: Shape):
+        c = input_shape[-1]
+        params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+        state = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+        return params, state, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
+            m = self.momentum
+            new_state = {
+                "mean": m * state["mean"] + (1 - m) * mean,
+                "var": m * state["var"] + (1 - m) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = {}
+        inv = lax.rsqrt(var + self.epsilon) * params["scale"]
+        y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + params["bias"].astype(x.dtype)
+        return y, new_state
+
+
+class LayerNorm(Layer):
+    def __init__(self, epsilon: float = 1e-6, name=None):
+        super().__init__(name)
+        self.epsilon = float(epsilon)
+
+    def init(self, key, input_shape: Shape):
+        d = input_shape[-1]
+        params = {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.epsilon)
+        y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), {}
+
+
+class Embedding(Layer):
+    def __init__(self, vocab_size: int, dim: int, dtype=None, name=None):
+        super().__init__(name)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.dtype = dtype
+
+    def init(self, key, input_shape: Shape):
+        table = initializers.normal(0.02)(key, (self.vocab_size, self.dim), jnp.float32)
+        return {"table": table}, {}, tuple(input_shape) + (self.dim,)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        table = params["table"]
+        if self.dtype is not None:
+            table = table.astype(self.dtype)
+        return jnp.take(table, x, axis=0), {}

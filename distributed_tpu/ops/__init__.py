@@ -1,0 +1,3 @@
+from . import losses, metrics
+
+__all__ = ["losses", "metrics"]

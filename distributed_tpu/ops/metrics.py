@@ -1,0 +1,74 @@
+"""Metrics.
+
+Parity target: ``metrics = 'accuracy'`` (/root/reference/README.md:73, 302).
+
+Protocol: a metric maps (logits, labels) -> (sum, count). Epochs aggregate the
+two on device and divide once at the epoch boundary — exact under sharded
+batches, mirroring the reference's all-reduced running metrics
+(/root/reference/README.md:404-407). Known metrics also expose a per-example
+form (a (B,) score vector) so padded evaluation batches can be masked exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _accuracy_scores(logits, labels):
+    pred = jnp.argmax(logits, axis=-1)
+    return (pred == labels.astype(pred.dtype)).astype(jnp.float32)
+
+
+def accuracy(logits, labels):
+    scores = _accuracy_scores(logits, labels)
+    return jnp.sum(scores), jnp.float32(scores.size)
+
+
+def _top_k_scores(k):
+    def scores(logits, labels):
+        topk = jnp.argsort(logits, axis=-1)[..., -k:]
+        hit = jnp.any(topk == labels[..., None].astype(topk.dtype), axis=-1)
+        return hit.astype(jnp.float32)
+
+    return scores
+
+
+def top_k_accuracy(k: int):
+    sc = _top_k_scores(k)
+
+    def metric(logits, labels):
+        s = sc(logits, labels)
+        return jnp.sum(s), jnp.float32(s.size)
+
+    metric.__name__ = f"top_{k}_accuracy"
+    metric.per_example = sc
+    return metric
+
+
+accuracy.per_example = _accuracy_scores
+
+_REGISTRY = {
+    "accuracy": accuracy,
+    "acc": accuracy,
+    "top_5_accuracy": top_k_accuracy(5),
+}
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    try:
+        return _REGISTRY[name_or_fn]
+    except KeyError:
+        raise ValueError(f"Unknown metric {name_or_fn!r}; known: {sorted(_REGISTRY)}") from None
+
+
+def per_example(fn):
+    """Per-example score vector fn, or None if the metric doesn't expose one."""
+    return getattr(fn, "per_example", None)
+
+
+def name_of(name_or_fn) -> str:
+    if isinstance(name_or_fn, str):
+        return "accuracy" if name_or_fn == "acc" else name_or_fn
+    return getattr(name_or_fn, "__name__", "metric")

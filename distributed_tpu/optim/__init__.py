@@ -1,0 +1,44 @@
+"""Optimizers: Keras-shaped constructors over optax transforms.
+
+Parity target: ``optimizer_sgd(lr = 0.001)`` / ``tf.keras.optimizers.SGD``
+(/root/reference/README.md:71, 301). Optimizer state is an ordinary pytree, so
+it replicates/shards with the same ``NamedSharding`` rules as the parameters.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def SGD(learning_rate: float = 0.001, momentum: float = 0.0, nesterov: bool = False):
+    if momentum:
+        return optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
+    return optax.sgd(learning_rate)
+
+
+def Adam(learning_rate: float = 0.001, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+
+
+def AdamW(learning_rate: float = 0.001, weight_decay: float = 0.01, b1=0.9, b2=0.999):
+    return optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+
+
+def sgd_with_cosine(learning_rate: float, steps: int, warmup: int = 0, momentum: float = 0.9):
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, max(warmup, 1), max(steps, warmup + 1)
+    )
+    return optax.sgd(sched, momentum=momentum)
+
+
+_REGISTRY = {"sgd": SGD, "adam": Adam, "adamw": AdamW}
+
+
+def get(name_or_tx, **kwargs):
+    """Resolve 'sgd'/'adam'/'adamw' by name, or pass an optax transform through."""
+    if isinstance(name_or_tx, str):
+        try:
+            return _REGISTRY[name_or_tx.lower()](**kwargs)
+        except KeyError:
+            raise ValueError(f"Unknown optimizer {name_or_tx!r}") from None
+    return name_or_tx

@@ -1,0 +1,20 @@
+from .mesh import AXES, batch_sharding, make_mesh, replicated
+from .strategy import (
+    DataParallel,
+    MultiWorkerMirroredStrategy,
+    SingleDevice,
+    Strategy,
+    current_strategy,
+)
+
+__all__ = [
+    "AXES",
+    "make_mesh",
+    "replicated",
+    "batch_sharding",
+    "Strategy",
+    "SingleDevice",
+    "DataParallel",
+    "MultiWorkerMirroredStrategy",
+    "current_strategy",
+]

@@ -1,0 +1,64 @@
+"""Device-mesh construction.
+
+The mesh is the framework's single source of truth for parallelism: every
+strategy (DP today; TP/PP/SP/EP compose later) is an axis of one
+``jax.sharding.Mesh``. This replaces the reference's flat worker list in
+``TF_CONFIG`` (/root/reference/README.md:84-89, 322-327): where the reference
+enumerates gRPC endpoints, we enumerate chips and name axes, and XLA emits the
+collectives over ICI/DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Canonical axis names, in fixed order. DP is one axis of a general design so
+# the others compose later without re-plumbing (SURVEY.md §2c implication).
+AXES = ("data", "fsdp", "pipe", "seq", "expert", "model")
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over `devices` (default: all) with named axes.
+
+    ``axis_sizes`` maps axis name -> size; omitted axes get size 1 and are
+    dropped unless explicitly given. With no arguments, all devices go on the
+    'data' axis (pure DP — exactly the reference's MultiWorkerMirrored layout,
+    /root/reference/README.md:122,364, re-expressed as a mesh).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"data": n}
+    names = [a for a in AXES if a in axis_sizes]
+    unknown = set(axis_sizes) - set(AXES)
+    if unknown:
+        raise ValueError(f"Unknown mesh axes {sorted(unknown)}; valid: {AXES}")
+    sizes = [int(axis_sizes[a]) for a in names]
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            f"Mesh axes {dict(zip(names, sizes))} need {total} devices, got {n}"
+        )
+    try:
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:
+        dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dim across `axis`."""
+    return NamedSharding(mesh, PartitionSpec(axis))

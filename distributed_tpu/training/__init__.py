@@ -1,0 +1,4 @@
+from .history import History
+from .model import Model
+
+__all__ = ["Model", "History"]

@@ -1,0 +1,31 @@
+"""Training history object.
+
+Parity target: the Keras ``History`` whose ``metrics$accuracy`` the reference
+reads inside its Spark closure (/root/reference/README.md:220:
+``as.character(max(result$metrics$accuracy))``). ``history.metrics`` is kept
+as an alias of ``history.history`` so that R-side ``result$metrics$accuracy``
+keeps working through reticulate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class History:
+    def __init__(self):
+        self.history: Dict[str, List[float]] = {}
+        self.epoch: List[int] = []
+
+    def record(self, epoch: int, logs: Dict[str, float]):
+        self.epoch.append(epoch)
+        for k, v in logs.items():
+            self.history.setdefault(k, []).append(float(v))
+
+    @property
+    def metrics(self) -> Dict[str, List[float]]:
+        return self.history
+
+    def __repr__(self):
+        keys = ", ".join(self.history)
+        return f"History(epochs={len(self.epoch)}, metrics=[{keys}])"

@@ -1,0 +1,332 @@
+"""Keras-shaped ``Model``: compile / fit / evaluate / predict.
+
+Parity targets (what migrating users keep):
+- ``model.compile(loss=..., optimizer=..., metrics=['accuracy'])``
+  (/root/reference/README.md:300-302, 70-73).
+- ``model.fit(x, y, batch_size, epochs, steps_per_epoch)`` returning a
+  History (/root/reference/README.md:304, 392, 153); ``batch_size`` is the
+  *global* batch, exactly like the reference's ``64 * num_workers``
+  (/root/reference/README.md:124-125, 366-367).
+- Built under ``strategy.scope()`` -> distributed; built bare -> local
+  (scope-wraps-construction, /root/reference/README.md:134, 375).
+
+TPU-first internals (what changed under the hood):
+- One jitted train step: forward + backward + optimizer update + metrics in a
+  single XLA program; buffers donated so params update in place in HBM.
+- Under DataParallel the batch arrives sharded on the mesh's 'data' axis and
+  params replicated; XLA emits one fused gradient all-reduce per step over
+  ICI — the compiled equivalent of the reference's observed "Collective
+  batch_all_reduce: 6 all-reduces" (/root/reference/README.md:403).
+- Per-epoch metric aggregation happens on device as (sum, count) pairs; only
+  epoch boundaries synchronize to host (no per-step device->host stalls).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import optim
+from ..nn.core import Layer
+from ..ops import losses as losses_lib
+from ..ops import metrics as metrics_lib
+from ..parallel.strategy import SingleDevice, Strategy, current_strategy
+from ..utils import logging as dlog
+from ..utils.tree import tree_size
+from .history import History
+
+
+def _index_stream(n: int, batch: int, shuffle: bool, seed: Optional[int]):
+    """Yield index blocks forever; reshuffles each pass (Keras semantics:
+    with steps_per_epoch the cursor carries across epochs)."""
+    rng = np.random.default_rng(0 if seed is None else seed)
+    while True:
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        for start in range(0, n - batch + 1, batch):
+            yield order[start : start + batch]
+
+
+class Model:
+    """A trainable wrapper around a ``Layer`` (usually a ``Sequential``)."""
+
+    def __init__(self, module: Layer, name: Optional[str] = None):
+        if module.name is None:
+            module.name = module.default_name()
+        self.module = module
+        self.name = name or "model"
+        # Scope-wraps-construction: capture the ambient strategy now.
+        self.strategy: Strategy = current_strategy() or SingleDevice()
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.built = False
+        self.compiled = False
+        self.input_shape: Optional[Tuple[int, ...]] = None
+        self.step = 0  # global optimizer step (checkpoint/resume cursor)
+        self._seed = 0
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    # ------------------------------------------------------------------ build
+    def build(self, input_shape: Sequence[int], seed: int = 0):
+        """Materialize params/state for an unbatched input shape, placed
+        according to the strategy (replicated under DP)."""
+        self.input_shape = tuple(int(d) for d in input_shape)
+        self._seed = seed
+        key = jax.random.PRNGKey(seed)
+        params, state, _ = self.module.init(key, self.input_shape)
+        self.params = self.strategy.put_params(params)
+        self.state = self.strategy.put_params(state)
+        if self.compiled:
+            self.opt_state = self.strategy.put_params(self.tx.init(self.params))
+        self.built = True
+        return self
+
+    def compile(
+        self,
+        optimizer="sgd",
+        loss="sparse_categorical_crossentropy",
+        metrics: Iterable = ("accuracy",),
+        **optimizer_kwargs,
+    ):
+        self.tx = optim.get(optimizer, **optimizer_kwargs)
+        self.loss_fn = losses_lib.get(loss)
+        self.metric_fns = [(metrics_lib.name_of(m), metrics_lib.get(m)) for m in metrics]
+        self.compiled = True
+        self._train_step = self._eval_step = None
+        if self.built:
+            self.opt_state = self.strategy.put_params(self.tx.init(self.params))
+        return self
+
+    @property
+    def num_params(self) -> int:
+        if not self.built:
+            raise ValueError("Model not built")
+        return tree_size(self.params)
+
+    # ------------------------------------------------------------- train step
+    def _get_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        module, tx, loss_fn = self.module, self.tx, self.loss_fn
+        metric_fns = tuple(self.metric_fns)
+
+        def step(params, state, opt_state, x, y, rng):
+            def loss_f(p):
+                logits, new_state = module.apply(p, state, x, train=True, rng=rng)
+                return loss_fn(logits, y), (new_state, logits)
+
+            (loss, (new_state, logits)), grads = jax.value_and_grad(
+                loss_f, has_aux=True
+            )(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            mvals = {name: fn(logits, y) for name, fn in metric_fns}
+            return new_params, new_state, new_opt, loss, mvals
+
+        self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._train_step
+
+    def _get_eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+        module, loss_fn = self.module, self.loss_fn
+        metric_fns = tuple(self.metric_fns)
+        per_ex = losses_lib.get_per_example(self.loss_fn)
+
+        def step(params, state, x, y, mask):
+            logits, _ = module.apply(params, state, x, train=False)
+            valid = jnp.sum(mask)
+            if per_ex is not None:
+                loss_sum = jnp.sum(per_ex(logits, y) * mask)
+            else:
+                # Custom loss without a per-example form: whole-batch mean
+                # weighted by valid count (exact when the batch is unpadded).
+                loss_sum = loss_fn(logits, y) * valid
+            msums = {}
+            for name, fn in metric_fns:
+                scores = metrics_lib.per_example(fn)
+                if scores is not None:
+                    msums[name] = (jnp.sum(scores(logits, y) * mask), valid)
+                else:
+                    s, c = fn(logits, y)
+                    msums[name] = (s * valid / jnp.maximum(c, 1.0), valid)
+            return loss_sum, valid, msums
+
+        self._eval_step = jax.jit(step)
+        return self._eval_step
+
+    def _get_predict_step(self):
+        if self._predict_step is not None:
+            return self._predict_step
+        module = self.module
+
+        def step(params, state, x):
+            logits, _ = module.apply(params, state, x, train=False)
+            return logits
+
+        self._predict_step = jax.jit(step)
+        return self._predict_step
+
+    def _step_rng(self):
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed + 1), self.step)
+
+    # -------------------------------------------------------------------- fit
+    def fit(
+        self,
+        x,
+        y,
+        batch_size: int = 32,
+        epochs: int = 1,
+        steps_per_epoch: Optional[int] = None,
+        validation_data: Optional[Tuple] = None,
+        shuffle: bool = True,
+        verbose: int = 1,
+        initial_epoch: int = 0,
+        seed: Optional[int] = None,
+        callbacks: Sequence = (),
+    ) -> History:
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if not self.compiled:
+            raise RuntimeError("Call compile() before fit()")
+        if not self.built:
+            self.build(x.shape[1:], seed=0 if seed is None else seed)
+        n = x.shape[0]
+        if batch_size > n:
+            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        self.strategy.local_batch_size(batch_size)  # divisibility check
+        if steps_per_epoch is None:
+            steps_per_epoch = n // batch_size
+        step_fn = self._get_train_step()
+        history = History()
+        stream = _index_stream(n, batch_size, shuffle, seed)
+        is_chief = jax.process_index() == 0
+        for cb in callbacks:
+            cb.on_train_begin(self)
+        for epoch in range(initial_epoch, epochs):
+            t0 = time.perf_counter()
+            losses = []
+            msums: Dict[str, list] = {name: [] for name, _ in self.metric_fns}
+            for _ in range(steps_per_epoch):
+                idx = next(stream)
+                batch = self.strategy.put_batch({"x": x[idx], "y": y[idx]})
+                rng = self._step_rng()
+                self.params, self.state, self.opt_state, loss, mvals = step_fn(
+                    self.params, self.state, self.opt_state,
+                    batch["x"], batch["y"], rng,
+                )
+                self.step += 1
+                losses.append(loss)
+                for name, _ in self.metric_fns:
+                    msums[name].append(mvals[name])
+            # One host sync per epoch.
+            logs = {"loss": float(np.mean(jax.device_get(losses)))}
+            for name, pairs in msums.items():
+                pairs = jax.device_get(pairs)
+                s = sum(p[0] for p in pairs)
+                c = sum(p[1] for p in pairs)
+                logs[name] = float(s / max(c, 1.0))
+            if validation_data is not None:
+                val = self.evaluate(
+                    validation_data[0], validation_data[1],
+                    batch_size=batch_size, verbose=0,
+                )
+                logs.update({f"val_{k}": v for k, v in val.items()})
+            dt = time.perf_counter() - t0
+            history.record(epoch, logs)
+            for cb in callbacks:
+                cb.on_epoch_end(self, epoch, logs)
+            if verbose and is_chief:
+                samples = batch_size * steps_per_epoch
+                parts = " - ".join(f"{k}: {v:.4f}" for k, v in logs.items())
+                dlog.info(
+                    f"Epoch {epoch + 1}/{epochs} - {samples} samples - "
+                    f"{dt:.2f}s ({dt / steps_per_epoch * 1000:.1f}ms/step) - {parts}"
+                )
+        for cb in callbacks:
+            cb.on_train_end(self, history)
+        return history
+
+    # --------------------------------------------------------------- evaluate
+    def evaluate(self, x, y, batch_size: int = 32, verbose: int = 1) -> Dict[str, float]:
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if not (self.built and self.compiled):
+            raise RuntimeError("Model must be built and compiled")
+        n = x.shape[0]
+        # Keep the step shape static: partial batches (including n < batch)
+        # are padded and masked, so one compile covers everything and the
+        # replica-divisibility of batch_size is preserved under DP.
+        self.strategy.local_batch_size(batch_size)
+        step_fn = self._get_eval_step()
+        results = []  # device values; one host sync at the end
+        for start in range(0, n, batch_size):
+            xb = x[start : start + batch_size]
+            yb = y[start : start + batch_size]
+            valid = xb.shape[0]
+            if valid < batch_size:  # pad to keep shapes static (one compile)
+                pad = batch_size - valid
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
+                yb = np.concatenate([yb, np.repeat(yb[-1:], pad, axis=0)])
+            mask = np.zeros((batch_size,), np.float32)
+            mask[:valid] = 1.0
+            batch = self.strategy.put_batch({"x": xb, "y": yb, "m": mask})
+            results.append(
+                step_fn(self.params, self.state, batch["x"], batch["y"], batch["m"])
+            )
+        results = jax.device_get(results)
+        loss_sum = sum(float(r[0]) for r in results)
+        count = sum(float(r[1]) for r in results)
+        out = {"loss": loss_sum / max(count, 1.0)}
+        for name, _ in self.metric_fns:
+            s = sum(float(r[2][name][0]) for r in results)
+            c = sum(float(r[2][name][1]) for r in results)
+            out[name] = s / max(c, 1.0)
+        if verbose and jax.process_index() == 0:
+            parts = " - ".join(f"{k}: {v:.4f}" for k, v in out.items())
+            dlog.info(f"Evaluate - {n} samples - {parts}")
+        return out
+
+    # ---------------------------------------------------------------- predict
+    def predict(self, x, batch_size: int = 32) -> np.ndarray:
+        x = np.asarray(x)
+        if not self.built:
+            raise RuntimeError("Model not built")
+        n = x.shape[0]
+        self.strategy.local_batch_size(batch_size)
+        step_fn = self._get_predict_step()
+        outs = []
+        for start in range(0, n, batch_size):
+            xb = x[start : start + batch_size]
+            valid = xb.shape[0]
+            if valid < batch_size:
+                xb = np.concatenate([xb, np.repeat(xb[-1:], batch_size - valid, axis=0)])
+            xb = self.strategy.put_batch({"x": xb})["x"]
+            out = np.asarray(jax.device_get(step_fn(self.params, self.state, xb)))
+            outs.append(out[:valid])
+        return np.concatenate(outs, axis=0)
+
+    # ---------------------------------------------------------------- summary
+    def summary(self):
+        if self.input_shape is None:
+            raise ValueError("Build the model (or fit once) before summary()")
+        rows = self.module.summary_lines(self.input_shape)
+        width = max(len(r[0]) for r in rows) + 2
+        lines = [f"Model: {self.name}", "-" * (width + 30)]
+        total = 0
+        for name, shape, count in rows:
+            lines.append(f"{name:<{width}}{str(shape):<22}{count}")
+            total += count
+        lines.append("-" * (width + 30))
+        lines.append(f"Total params: {total}")
+        text = "\n".join(lines)
+        if jax.process_index() == 0:
+            print(text)
+        return text

@@ -1,0 +1,3 @@
+from . import logging, tree
+
+__all__ = ["logging", "tree"]

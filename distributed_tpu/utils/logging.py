@@ -1,0 +1,47 @@
+"""Structured step/epoch logging.
+
+The reference's only observability is TF INFO logs + the Keras progress bar
+(/root/reference/README.md:395-412, 309-311). Here: a standard `logging`
+logger, chief-only by default (process 0), plus an optional JSONL event sink
+for machine-readable training telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+_logger = logging.getLogger("distributed_tpu")
+if not _logger.handlers:
+    h = logging.StreamHandler()
+    h.setFormatter(logging.Formatter("[dtpu %(asctime)s] %(message)s", "%H:%M:%S"))
+    _logger.addHandler(h)
+    _logger.setLevel(os.environ.get("DTPU_LOG_LEVEL", "INFO"))
+    _logger.propagate = False
+
+_jsonl_path: Optional[str] = None
+
+
+def info(msg: str):
+    _logger.info(msg)
+
+
+def warning(msg: str):
+    _logger.warning(msg)
+
+
+def set_jsonl(path: Optional[str]):
+    """Mirror events to a JSONL file (one object per event)."""
+    global _jsonl_path
+    _jsonl_path = path
+
+
+def event(kind: str, **fields):
+    """Emit a structured event (chief decides whether to call)."""
+    if _jsonl_path:
+        rec = {"ts": time.time(), "event": kind, **fields}
+        with open(_jsonl_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,29 @@
+"""Test env: simulate 8 devices on CPU so DP/mesh semantics run without a pod.
+
+Must set the flags before jax initializes (same before-library-init ordering
+the reference demands for TF_CONFIG, /root/reference/README.md:316-317).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The env var alone loses to preinstalled platform plugins (e.g. the 'axon'
+# TPU tunnel); the config update is authoritative.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,97 @@
+"""End-to-end single-device training tests.
+
+Models the reference's own quality checks (SURVEY.md §4): the local smoke
+train must decrease loss; fit semantics (steps_per_epoch, History) must match
+the reference's Keras contract (/root/reference/README.md:304, 392).
+"""
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+
+
+def small_data(n=512, seed=0):
+    x, y = dtpu.data.synthetic_images(n, (28, 28), 10, seed)
+    return x[..., None].astype(np.float32) / 255.0, y.astype(np.int32)
+
+
+def make_model():
+    m = dtpu.Model(dtpu.models.mnist_cnn())
+    m.compile(optimizer=dtpu.optim.SGD(0.05), loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    return m
+
+
+def test_fit_decreases_loss_and_returns_history():
+    x, y = small_data()
+    model = make_model()
+    hist = model.fit(x, y, batch_size=64, epochs=3, verbose=0, seed=0)
+    assert hist.epoch == [0, 1, 2]
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0]
+    assert "accuracy" in hist.history
+    # History.metrics alias: the reference's Spark closure reads
+    # result$metrics$accuracy (README.md:220).
+    assert hist.metrics is hist.history
+
+
+def test_steps_per_epoch_semantics():
+    x, y = small_data(n=256)
+    model = make_model()
+    hist = model.fit(x, y, batch_size=64, epochs=3, steps_per_epoch=2, verbose=0)
+    assert model.step == 6  # 3 epochs x 2 steps, reference's 3x5 pattern
+
+
+def test_accuracy_improves_to_high_on_separable_synthetic():
+    x, y = small_data(n=1024)
+    model = make_model()
+    hist = model.fit(x, y, batch_size=128, epochs=8, verbose=0, seed=1)
+    assert hist.history["accuracy"][-1] > 0.9
+
+
+def test_evaluate_matches_fit_metrics_and_handles_remainder():
+    x, y = small_data(n=300)  # not divisible by 64 -> padded final batch
+    model = make_model()
+    model.fit(x, y, batch_size=50, epochs=4, verbose=0)
+    out = model.evaluate(x, y, batch_size=64, verbose=0)
+    assert set(out) == {"loss", "accuracy"}
+    assert 0.0 <= out["accuracy"] <= 1.0
+    # Exactness check of masking: evaluating twice is deterministic.
+    out2 = model.evaluate(x, y, batch_size=64, verbose=0)
+    assert out == out2
+    # And batch size > n works (clamped).
+    out3 = model.evaluate(x[:10], y[:10], batch_size=64, verbose=0)
+    assert 0.0 <= out3["accuracy"] <= 1.0
+
+
+def test_predict_shapes_and_consistency():
+    x, y = small_data(n=100)
+    model = make_model()
+    model.build((28, 28, 1))
+    preds = model.predict(x, batch_size=32)
+    assert preds.shape == (100, 10)
+    preds2 = model.predict(x, batch_size=64)
+    np.testing.assert_allclose(preds, preds2, rtol=1e-5, atol=1e-5)
+
+
+def test_validation_data():
+    x, y = small_data(n=256)
+    xv, yv = small_data(n=128, seed=7)
+    model = make_model()
+    hist = model.fit(x, y, batch_size=64, epochs=2, validation_data=(xv, yv), verbose=0)
+    assert "val_loss" in hist.history and "val_accuracy" in hist.history
+
+
+def test_uncompiled_fit_raises():
+    model = dtpu.Model(dtpu.models.mnist_cnn())
+    x, y = small_data(n=64)
+    with pytest.raises(RuntimeError):
+        model.fit(x, y, batch_size=32, verbose=0)
+
+
+def test_summary_param_total():
+    model = make_model()
+    model.build((28, 28, 1))
+    text = model.summary()
+    assert "347146" in text

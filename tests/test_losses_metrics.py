@@ -1,0 +1,53 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tpu.ops import losses, metrics
+
+
+def test_sparse_cce_matches_manual():
+    logits = jnp.array([[2.0, 1.0, 0.0], [0.0, 0.0, 5.0]])
+    labels = jnp.array([0, 2])
+    got = losses.sparse_categorical_crossentropy(logits, labels)
+    logp = jax.nn.log_softmax(logits)
+    want = -(logp[0, 0] + logp[1, 2]) / 2
+    assert jnp.allclose(got, want)
+
+
+def test_loss_class_form():
+    fn = losses.SparseCategoricalCrossentropy(from_logits=True)
+    logits = jnp.array([[10.0, 0.0]])
+    assert float(fn(logits, jnp.array([0]))) < 1e-3
+
+
+def test_per_example_consistent_with_mean():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (32, 10))
+    labels = jax.random.randint(jax.random.PRNGKey(1), (32,), 0, 10)
+    mean = losses.sparse_categorical_crossentropy(logits, labels)
+    per = losses.get_per_example(losses.sparse_categorical_crossentropy)(logits, labels)
+    assert per.shape == (32,)
+    assert jnp.allclose(jnp.mean(per), mean, rtol=1e-5)
+
+
+def test_accuracy_sum_count():
+    logits = jnp.array([[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]])
+    labels = jnp.array([0, 1, 1])
+    s, c = metrics.accuracy(logits, labels)
+    assert (float(s), float(c)) == (2.0, 3.0)
+
+
+def test_top_k():
+    m = metrics.get("top_5_accuracy")
+    logits = jnp.tile(jnp.arange(10.0), (4, 1))
+    labels = jnp.array([9, 5, 4, 0])
+    s, c = m(logits, labels)
+    assert float(s) == 2.0  # classes 9 and 5 are in top-5
+
+
+def test_cross_entropy_with_ignore():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 10))
+    labels = jnp.full((2, 5), -100)
+    labels = labels.at[0, 0].set(3)
+    loss = losses.cross_entropy_with_ignore(logits, labels)
+    want = losses.sparse_categorical_crossentropy(logits[0:1, 0], jnp.array([3]))
+    assert jnp.allclose(loss, want, rtol=1e-5)

@@ -1,0 +1,107 @@
+"""Layer-level tests: shape inference, parameter counts, forward semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_tpu import nn
+from distributed_tpu.models import mnist_cnn
+from distributed_tpu.utils.tree import tree_size
+
+
+def test_mnist_cnn_param_count_matches_reference():
+    # 347,146 params / 6 tensors — BASELINE.md model-size row, derived from
+    # /root/reference/README.md:292-298.
+    model = mnist_cnn()
+    params, state, out = model.init(jax.random.PRNGKey(0), (28, 28, 1))
+    assert out == (10,)
+    assert tree_size(params) == 347_146
+    assert len(jax.tree_util.tree_leaves(params)) == 6
+    assert state == {}
+
+
+def test_sequential_shapes_and_forward():
+    model = mnist_cnn()
+    params, state, _ = model.init(jax.random.PRNGKey(0), (28, 28, 1))
+    x = jnp.ones((4, 28, 28, 1))
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (4, 10)
+    assert jnp.isfinite(y).all()
+
+
+def test_conv2d_same_and_stride():
+    layer = nn.Conv2D(8, 3, strides=2, padding="same")
+    params, _, out = layer.init(jax.random.PRNGKey(0), (28, 28, 3))
+    assert out == (14, 14, 8)
+    y, _ = layer.apply(params, {}, jnp.ones((2, 28, 28, 3)))
+    assert y.shape == (2, 14, 14, 8)
+
+
+def test_dense_on_sequence_input():
+    layer = nn.Dense(16)
+    params, _, out = layer.init(jax.random.PRNGKey(0), (12, 8))
+    assert out == (12, 16)
+    y, _ = layer.apply(params, {}, jnp.ones((2, 12, 8)))
+    assert y.shape == (2, 12, 16)
+
+
+def test_pooling():
+    mp = nn.MaxPool2D(2)
+    _, _, out = mp.init(jax.random.PRNGKey(0), (28, 28, 4))
+    assert out == (14, 14, 4)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = mp.apply({}, {}, x)
+    assert y[0, 0, 0, 0] == 5.0  # max of [[0,1],[4,5]]
+    ap = nn.AvgPool2D(2)
+    y, _ = ap.apply({}, {}, x)
+    assert y[0, 0, 0, 0] == 2.5
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(momentum=0.5)
+    params, state, _ = bn.init(jax.random.PRNGKey(0), (8,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 8)) * 3 + 2
+    y, new_state = bn.apply(params, state, x, train=True)
+    # Normalized output: ~zero mean, ~unit var.
+    assert jnp.abs(jnp.mean(y)) < 1e-4
+    assert jnp.abs(jnp.std(y) - 1.0) < 1e-2
+    # Running stats moved toward batch stats.
+    assert jnp.all(new_state["mean"] != state["mean"])
+    # Eval path uses running stats and returns no state update.
+    y2, s2 = bn.apply(params, new_state, x, train=False)
+    assert s2 == {}
+
+
+def test_dropout_train_and_inference():
+    do = nn.Dropout(0.5)
+    x = jnp.ones((1000,))
+    y, _ = do.apply({}, {}, x, train=False)
+    assert (y == x).all()
+    y, _ = do.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(0))
+    kept = float(jnp.mean((y > 0).astype(jnp.float32)))
+    assert 0.4 < kept < 0.6
+    assert jnp.allclose(y[y > 0], 2.0)
+    with pytest.raises(ValueError):
+        do.apply({}, {}, x, train=True)
+
+
+def test_layer_auto_naming():
+    model = nn.Sequential([nn.Dense(4), nn.Dense(4), nn.Conv2D(3, 1)])
+    names = [l.name for l in model.layers]
+    assert names == ["dense", "dense_1", "conv2d"]
+    with pytest.raises(ValueError):
+        nn.Sequential([nn.Dense(4, name="a"), nn.Dense(4, name="a")])
+
+
+def test_embedding_and_layernorm():
+    emb = nn.Embedding(100, 16)
+    params, _, out = emb.init(jax.random.PRNGKey(0), (12,))
+    assert out == (12, 16)
+    tokens = jnp.array([[1, 2, 3]])
+    y, _ = emb.apply(params, {}, tokens)
+    assert y.shape == (1, 3, 16)
+    ln = nn.LayerNorm()
+    p, _, _ = ln.init(jax.random.PRNGKey(0), (16,))
+    z, _ = ln.apply(p, {}, y)
+    assert jnp.abs(jnp.mean(z)) < 1e-4
