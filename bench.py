@@ -1,0 +1,85 @@
+"""Benchmark: MNIST CNN training steps/sec on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}
+
+Baseline: the reference's steady-state distributed rate — epochs 2-3 take ~9s
+for 5 steps at global batch 256 on the 4-worker gRPC CollectiveAllReduce setup
+(/root/reference/README.md:413-414, BASELINE.md) => 0.556 steps/s. The
+north-star target is >=4x that (BASELINE.json).
+
+Method: the same global-batch-256 train step (forward + backward + SGD update
++ metrics, exactly what fit() runs), steady-state: pre-staged device batches,
+warmup for compile, then timed steps with a final block. Runs on whatever
+devices are available (1 real chip here; a DP mesh if several).
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+
+import distributed_tpu as dtpu
+
+BASELINE_STEPS_PER_SEC = 5.0 / 9.0  # README.md:413-414
+GLOBAL_BATCH = 256  # reference's 4-worker global batch (README.md:366-367)
+WARMUP, MEASURE = 10, 100
+
+
+def main():
+    n_dev = len(jax.devices())
+    if n_dev > 1:
+        strategy = dtpu.DataParallel()
+    else:
+        strategy = dtpu.SingleDevice()
+    with strategy.scope():
+        model = dtpu.Model(dtpu.models.mnist_cnn())
+        model.compile(
+            optimizer=dtpu.optim.SGD(0.001),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+    model.build((28, 28, 1))
+
+    x, y = dtpu.data.synthetic_images(GLOBAL_BATCH * 4, (28, 28), 10, 0)
+    x = x[..., None].astype(np.float32) / 255.0
+    y = y.astype(np.int32)
+    batches = [
+        model.strategy.put_batch(
+            {"x": x[i * GLOBAL_BATCH : (i + 1) * GLOBAL_BATCH],
+             "y": y[i * GLOBAL_BATCH : (i + 1) * GLOBAL_BATCH]}
+        )
+        for i in range(4)
+    ]
+
+    step_fn = model._get_train_step()
+    rng = jax.random.PRNGKey(0)
+    params, state, opt = model.params, model.state, model.opt_state
+    for i in range(WARMUP):
+        b = batches[i % 4]
+        params, state, opt, loss, _ = step_fn(params, state, opt, b["x"], b["y"], rng)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE):
+        b = batches[i % 4]
+        params, state, opt, loss, _ = step_fn(params, state, opt, b["x"], b["y"], rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = MEASURE / dt
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_cnn_train_steps_per_sec_gb256",
+                "value": round(steps_per_sec, 2),
+                "unit": "steps/s",
+                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

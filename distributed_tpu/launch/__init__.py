@@ -1,0 +1,15 @@
+from .core import (
+    LocalLauncher,
+    SSHLauncher,
+    WorkerResult,
+    launch_local,
+    report_result,
+)
+
+__all__ = [
+    "LocalLauncher",
+    "SSHLauncher",
+    "WorkerResult",
+    "launch_local",
+    "report_result",
+]

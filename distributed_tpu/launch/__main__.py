@@ -1,0 +1,72 @@
+"""CLI: gang-launch a training script.
+
+    dtpu-launch --num-workers 4 script.py [script args...]
+    dtpu-launch --hosts host1,host2,host3 script.py [script args...]
+
+Replaces both of the reference's launch modes — manual per-machine sessions
+(/root/reference/README.md:82-114) and the Spark barrier job
+(README.md:170-224) — with one command. Prints one result row per worker
+(the collect() tibble shape, README.md:226-232) and exits nonzero if any
+worker failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import core
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="dtpu-launch", description=__doc__)
+    ap.add_argument("--num-workers", type=int, default=None,
+                    help="local processes to spawn (CPU sim / single host)")
+    ap.add_argument("--hosts", type=str, default=None,
+                    help="comma-separated remote hosts (one worker per host, via ssh)")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--base-port", type=int, default=None)
+    ap.add_argument("--python", type=str, default=sys.executable)
+    ap.add_argument("--results-json", type=str, default=None,
+                    help="write the worker result rows to this file")
+    ap.add_argument("script", type=str)
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    worker_argv = [args.python, args.script] + list(args.script_args)
+    if args.hosts:
+        kw = {"port": args.base_port} if args.base_port else {}
+        launcher = core.SSHLauncher(args.hosts.split(","), **kw)
+        results = launcher.run(worker_argv, timeout=args.timeout)
+    else:
+        n = args.num_workers or 1
+        results = core.LocalLauncher().run(
+            worker_argv, n, timeout=args.timeout, base_port=args.base_port
+        )
+
+    rows = [
+        {
+            "index": r.index,
+            "ok": r.ok,
+            "value": r.value,
+            "error": r.error,
+            "exit_code": r.exit_code,
+        }
+        for r in results
+    ]
+    for r in results:
+        status = "ok" if r.ok else f"FAILED ({r.error})"
+        print(f"worker {r.index}: {status}  value={r.value!r}")
+        if not r.ok and r.log_tail:
+            print("  --- log tail ---")
+            for line in r.log_tail.splitlines()[-15:]:
+                print(f"  {line}")
+    if args.results_json:
+        with open(args.results_json, "w") as f:
+            json.dump(rows, f, indent=2)
+    return 0 if all(r.ok for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""Gang launcher: run the same program on every worker, gather results.
+
+Parity target: the reference's three launchers (SURVEY.md §1 L6) —
+(a) manual per-machine sessions differing only in task.index
+    (/root/reference/README.md:82-114, 318-358),
+(b) ``sparklyr::spark_apply(closure, barrier = TRUE)`` gang-scheduling with
+    per-worker rank + peer list injection (/root/reference/README.md:170-224),
+(c) per-worker error capture: the closure's ``tryCatch`` turns a worker
+    exception into a result row instead of hanging the job
+    (/root/reference/README.md:176, 221).
+
+TPU-native redesign: one OS process per TPU host (each owning its local
+chips), config injected via DTPU_CONFIG (the TF_CONFIG descendant), results
+and errors returned through a per-worker JSON file — the launcher's
+``collect()``-like return is a list of WorkerResult, one per worker, errors
+included as data (never a hang).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from ..cluster import config as config_lib
+from ..cluster import net
+from ..utils import logging as dlog
+
+RESULT_ENV = "DTPU_RESULT_FILE"
+RESULT_STDOUT_ENV = "DTPU_RESULT_STDOUT"  # ssh mode: frame result on stdout
+STDOUT_MARK = "___DTPU_RESULT___"
+
+
+@dataclasses.dataclass
+class WorkerResult:
+    """One row per worker — the shape of the reference's Spark collect()
+    (/root/reference/README.md:223-232)."""
+
+    index: int
+    ok: bool
+    value: Optional[object] = None  # worker-reported result (report_result)
+    error: Optional[str] = None  # exception text, tryCatch-style
+    exit_code: Optional[int] = None
+    log_tail: str = ""
+
+
+def report_result(value):
+    """Called by worker code to return a value to the launcher (the
+    equivalent of the Spark closure's return value, README.md:220).
+
+    Transport depends on how the worker was launched: a result file for
+    local gangs, stdout framing for ssh workers."""
+    path = os.environ.get(RESULT_ENV)
+    if path:
+        with open(path, "w") as f:
+            json.dump({"value": value}, f)
+    elif os.environ.get(RESULT_STDOUT_ENV) == "1":
+        print(STDOUT_MARK + json.dumps(value), flush=True)
+
+
+def _read_result(path: Path):
+    try:
+        with open(path) as f:
+            return json.load(f).get("value")
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _tail(path: Path, max_bytes: int = 4096) -> str:
+    try:
+        data = path.read_bytes()
+        return data[-max_bytes:].decode(errors="replace")
+    except OSError:
+        return ""
+
+
+class LocalLauncher:
+    """Spawn N worker processes on this machine (CPU-sim CI and single-host
+    multi-chip runs). Gang semantics: all start together; on any worker's
+    crash the rest are killed after `grace` rather than hanging at the next
+    collective — the failure surfaces as that worker's result row."""
+
+    def __init__(self, env_extra: Optional[Dict[str, str]] = None):
+        self.env_extra = dict(env_extra or {})
+
+    def run(
+        self,
+        argv: Sequence[str],
+        num_workers: int,
+        *,
+        timeout: float = 600.0,
+        grace: float = 10.0,
+        workdir: Optional[str] = None,
+        base_port: Optional[int] = None,
+    ) -> List[WorkerResult]:
+        port = base_port or net.free_port()
+        workers = [f"127.0.0.1:{port + i}" for i in range(num_workers)]
+        tmp = Path(tempfile.mkdtemp(prefix="dtpu_launch_"))
+        procs = []
+        for i in range(num_workers):
+            spec = config_lib.ClusterSpec(workers=workers, index=i)
+            env = dict(os.environ)
+            env.update(self.env_extra)
+            env[config_lib.ENV_VAR] = spec.to_json()
+            env[RESULT_ENV] = str(tmp / f"result-{i}.json")
+            log = open(tmp / f"worker-{i}.log", "wb")
+            procs.append(
+                (
+                    subprocess.Popen(
+                        list(argv),
+                        env=env,
+                        stdout=log,
+                        stderr=subprocess.STDOUT,
+                        cwd=workdir,
+                    ),
+                    log,
+                )
+            )
+        deadline = time.time() + timeout
+        results: List[Optional[WorkerResult]] = [None] * num_workers
+        pending = set(range(num_workers))
+        first_failure: Optional[float] = None
+        while pending:
+            now = time.time()
+            for i in list(pending):
+                proc, _ = procs[i]
+                rc = proc.poll()
+                if rc is not None:
+                    pending.discard(i)
+                    log_path = tmp / f"worker-{i}.log"
+                    value = _read_result(tmp / f"result-{i}.json")
+                    err = None if rc == 0 else f"exit code {rc}"
+                    results[i] = WorkerResult(
+                        index=i,
+                        ok=rc == 0,
+                        value=value,
+                        error=err,
+                        exit_code=rc,
+                        log_tail=_tail(log_path) if rc != 0 else "",
+                    )
+                    if rc != 0 and first_failure is None:
+                        first_failure = now
+            if pending and (
+                now > deadline
+                or (first_failure is not None and now > first_failure + grace)
+            ):
+                reason = (
+                    "timeout"
+                    if now > deadline
+                    else "killed after peer failure (gang semantics)"
+                )
+                for i in list(pending):
+                    proc, _ = procs[i]
+                    proc.kill()
+                    proc.wait()
+                    results[i] = WorkerResult(
+                        index=i,
+                        ok=False,
+                        value=_read_result(tmp / f"result-{i}.json"),
+                        error=reason,
+                        exit_code=None,
+                        log_tail=_tail(tmp / f"worker-{i}.log"),
+                    )
+                pending.clear()
+            time.sleep(0.05)
+        for proc, log in procs:
+            log.close()
+        return [r for r in results if r is not None]
+
+
+class SSHLauncher:
+    """Spawn one worker per remote host over ssh (TPU pod-style deployments
+    where each host runs the same program against its local chips — the
+    reference's per-machine manual sessions, README.md:82-114, automated).
+
+    Assumes passwordless ssh and a shared filesystem or pre-synced code, the
+    same operational posture as the reference's EC2 recipe (README.md:9-19).
+    Results come back over stdout framing rather than files.
+    """
+
+    MARK = STDOUT_MARK
+
+    def __init__(self, hosts: Sequence[str], *, ssh_cmd: str = "ssh", port: int = 8476):
+        self.hosts = list(hosts)
+        self.ssh_cmd = ssh_cmd
+        self.port = port
+
+    def run(
+        self,
+        argv: Sequence[str],
+        *,
+        timeout: float = 3600.0,
+        env_extra: Optional[Dict[str, str]] = None,
+    ) -> List[WorkerResult]:
+        workers = [f"{h}:{self.port}" for h in self.hosts]
+        unreachable = [w for w, ok in net.preflight(workers).items() if not ok]
+        if unreachable:
+            raise RuntimeError(f"Preflight failed for: {unreachable}")
+        procs = []
+        for i, host in enumerate(self.hosts):
+            spec = config_lib.ClusterSpec(workers=workers, index=i)
+            exports = {
+                config_lib.ENV_VAR: spec.to_json(),
+                RESULT_STDOUT_ENV: "1",
+                **(env_extra or {}),
+            }
+            export_str = " ".join(
+                f"{k}={json.dumps(v)}" for k, v in exports.items()
+            )
+            remote = f"{export_str} {' '.join(argv)}"
+            procs.append(
+                subprocess.Popen(
+                    [self.ssh_cmd, host, remote],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        results = []
+        for i, proc in enumerate(procs):
+            try:
+                out, _ = proc.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out, _ = proc.communicate()
+            value = None
+            for line in (out or "").splitlines():
+                if line.startswith(self.MARK):
+                    try:
+                        value = json.loads(line[len(self.MARK):])
+                    except json.JSONDecodeError:
+                        pass
+            results.append(
+                WorkerResult(
+                    index=i,
+                    ok=proc.returncode == 0,
+                    value=value,
+                    error=None if proc.returncode == 0 else f"exit code {proc.returncode}",
+                    exit_code=proc.returncode,
+                    log_tail="" if proc.returncode == 0 else (out or "")[-4096:],
+                )
+            )
+        return results
+
+
+def launch_local(argv: Sequence[str], num_workers: int, **kw) -> List[WorkerResult]:
+    return LocalLauncher().run(argv, num_workers, **kw)

@@ -111,7 +111,9 @@ class DataParallel(Strategy):
 
     @property
     def num_replicas_in_sync(self) -> int:
-        return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        # Only the batch axis counts: on a multi-axis mesh (e.g. data x model)
+        # the other axes shard the model, not the batch.
+        return int(self.mesh.shape[self.axis])
 
     def params_sharding(self, params):
         rep = NamedSharding(self.mesh, PartitionSpec())

@@ -41,14 +41,27 @@ from ..utils.tree import tree_size
 from .history import History
 
 
-def _index_stream(n: int, batch: int, shuffle: bool, seed: Optional[int]):
+def _index_stream(
+    n: int, batch: int, shuffle: bool, seed: Optional[int], start_step: int = 0
+):
     """Yield index blocks forever; reshuffles each pass (Keras semantics:
-    with steps_per_epoch the cursor carries across epochs)."""
-    rng = np.random.default_rng(0 if seed is None else seed)
+    with steps_per_epoch the cursor carries across epochs).
+
+    Each pass's permutation depends only on (seed, pass index), so a resumed
+    run (``start_step`` = restored ``model.step``) fast-forwards to the exact
+    batch the interrupted run would have consumed next — this is what makes
+    checkpoint-resume match an uninterrupted run batch-for-batch."""
+    base = 0 if seed is None else seed
+    per_pass = max((n - batch) // batch + 1, 1)
+    pass_idx, within = divmod(start_step, per_pass)
     while True:
+        rng = np.random.default_rng((base, pass_idx))
         order = rng.permutation(n) if shuffle else np.arange(n)
-        for start in range(0, n - batch + 1, batch):
+        starts = range(0, n - batch + 1, batch)
+        for start in list(starts)[within:]:
             yield order[start : start + batch]
+        within = 0
+        pass_idx += 1
 
 
 class Model:
@@ -206,7 +219,7 @@ class Model:
             steps_per_epoch = n // batch_size
         step_fn = self._get_train_step()
         history = History()
-        stream = _index_stream(n, batch_size, shuffle, seed)
+        stream = _index_stream(n, batch_size, shuffle, seed, start_step=self.step)
         is_chief = jax.process_index() == 0
         for cb in callbacks:
             cb.on_train_begin(self)

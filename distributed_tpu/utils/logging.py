@@ -19,7 +19,8 @@ if not _logger.handlers:
     h = logging.StreamHandler()
     h.setFormatter(logging.Formatter("[dtpu %(asctime)s] %(message)s", "%H:%M:%S"))
     _logger.addHandler(h)
-    _logger.setLevel(os.environ.get("DTPU_LOG_LEVEL", "INFO"))
+    _level = os.environ.get("DTPU_LOG_LEVEL", "INFO").upper()
+    _logger.setLevel(_level if _level in logging._nameToLevel else "INFO")
     _logger.propagate = False
 
 _jsonl_path: Optional[str] = None

@@ -1,0 +1,66 @@
+import json
+import os
+
+import pytest
+
+from distributed_tpu.cluster import ClusterSpec, config, from_barrier, net
+
+
+def test_spec_json_roundtrip():
+    spec = ClusterSpec(workers=["a:1", "b:2", "c:3"], index=2)
+    again = ClusterSpec.from_json(spec.to_json())
+    assert again.workers == spec.workers and again.index == 2
+    assert again.coordinator == "a:1"
+    assert not again.is_chief
+
+
+def test_tf_config_env_compat(monkeypatch):
+    # The reference's exact TF_CONFIG shape (/root/reference/README.md:322-327).
+    tf_config = {
+        "cluster": {"worker": ["172.17.0.3:10087", "172.17.0.4:10088"]},
+        "task": {"type": "worker", "index": 1},
+    }
+    monkeypatch.delenv(config.ENV_VAR, raising=False)
+    monkeypatch.setenv(config.TF_ENV_VAR, json.dumps(tf_config))
+    spec = config.from_env()
+    assert spec.workers[0] == "172.17.0.3:10087"
+    assert spec.index == 1
+
+
+def test_dtpu_config_takes_priority(monkeypatch):
+    monkeypatch.setenv(config.TF_ENV_VAR, json.dumps(
+        {"cluster": {"worker": ["x:1"]}, "task": {"index": 0}}))
+    monkeypatch.setenv(config.ENV_VAR, json.dumps(
+        {"cluster": {"worker": ["y:1", "y:2"]}, "task": {"index": 1}}))
+    spec = config.from_env()
+    assert spec.workers == ["y:1", "y:2"] and spec.index == 1
+
+
+def test_from_barrier_matches_reference_construction():
+    # README.md:180-183: strip the Spark port, re-port as 8000+seq.
+    addresses = ["10.0.0.5:55001", "10.0.0.6:55002", "10.0.0.7:55003"]
+    spec = from_barrier(addresses, partition=2)
+    assert spec.workers == ["10.0.0.5:8001", "10.0.0.6:8002", "10.0.0.7:8003"]
+    assert spec.index == 2
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        ClusterSpec(workers=[], index=0).validate()
+    with pytest.raises(ValueError):
+        ClusterSpec(workers=["a:1"], index=5).validate()
+    with pytest.raises(ValueError):
+        ClusterSpec(workers=["noport"], index=0).validate()
+    with pytest.raises(ValueError):
+        ClusterSpec.from_json(json.dumps(
+            {"cluster": {"worker": ["a:1"]}, "task": {"type": "ps", "index": 0}}))
+
+
+def test_net_helpers():
+    ip = net.my_ip()
+    assert ip.count(".") == 3
+    port = net.free_port()
+    assert 1024 <= port <= 65535
+    # Unresolvable hostname -> False (sandboxed networks may report plain
+    # refusal for unroutable IPs, which counts as host-up by design).
+    assert net.check_reachable("no-such-host.invalid:1", timeout=0.5) is False

@@ -1,0 +1,133 @@
+"""Launcher tests: gang spawn, config injection, result/error gather.
+
+These reproduce the reference's launcher semantics without Spark
+(SURVEY.md §7 hard parts): barrier-style gang scheduling
+(/root/reference/README.md:179), rank + peer-list injection
+(README.md:180-183), and tryCatch-style error-as-result rows
+(README.md:176, 221).
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from distributed_tpu.launch import LocalLauncher
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+def write_worker(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import os, sys, json
+            sys.path.insert(0, {REPO!r})
+            """
+        )
+        + textwrap.dedent(body)
+    )
+    return str(script)
+
+
+def test_config_injection_and_results(tmp_path):
+    script = write_worker(
+        tmp_path,
+        """
+        from distributed_tpu.cluster import from_env
+        from distributed_tpu.launch import report_result
+        spec = from_env()
+        report_result({"rank": spec.index, "n": spec.num_processes,
+                       "peers": spec.workers})
+        """,
+    )
+    results = LocalLauncher().run([sys.executable, script], 3, timeout=60)
+    assert len(results) == 3
+    assert all(r.ok for r in results)
+    ranks = sorted(r.value["rank"] for r in results)
+    assert ranks == [0, 1, 2]
+    assert all(r.value["n"] == 3 for r in results)
+    # Every worker sees the same rank-ordered peer list (README.md:84-114).
+    peers = {tuple(r.value["peers"]) for r in results}
+    assert len(peers) == 1
+
+
+def test_error_capture_as_result_row(tmp_path):
+    script = write_worker(
+        tmp_path,
+        """
+        from distributed_tpu.cluster import from_env
+        spec = from_env()
+        if spec.index == 1:
+            raise RuntimeError("boom on worker 1")
+        from distributed_tpu.launch import report_result
+        report_result("fine")
+        """,
+    )
+    results = LocalLauncher().run([sys.executable, script], 2, timeout=60, grace=5)
+    by_rank = {r.index: r for r in results}
+    assert by_rank[0].ok and by_rank[0].value == "fine"
+    assert not by_rank[1].ok
+    assert "boom on worker 1" in by_rank[1].log_tail
+
+
+def test_cli_end_to_end(tmp_path):
+    script = write_worker(
+        tmp_path,
+        """
+        from distributed_tpu.cluster import from_env
+        from distributed_tpu.launch import report_result
+        report_result(from_env().index * 10)
+        """,
+    )
+    out = tmp_path / "results.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "distributed_tpu.launch",
+         "--num-workers", "2", "--results-json", str(out), script],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = json.loads(out.read_text())
+    assert sorted(r["value"] for r in rows) == [0, 10]
+
+
+@pytest.mark.slow
+def test_distributed_training_via_launcher(tmp_path):
+    """Full stack: gang launch -> jax.distributed over CPU processes -> DP
+    train -> identical metrics on every worker (the reference's invariant,
+    README.md:226-232)."""
+    script = write_worker(
+        tmp_path,
+        """
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import distributed_tpu as dtpu
+        from distributed_tpu.launch import report_result
+
+        spec = dtpu.cluster.initialize()
+        x, y = dtpu.data.synthetic_images(256, (28, 28), 10, 0)
+        x = x[..., None].astype(np.float32) / 255.0
+
+        strategy = dtpu.DataParallel()
+        with strategy.scope():
+            m = dtpu.Model(dtpu.models.mnist_cnn())
+            m.compile(optimizer=dtpu.optim.SGD(0.05), metrics=["accuracy"])
+        hist = m.fit(x, y.astype(np.int32), batch_size=64, epochs=2,
+                     steps_per_epoch=3, verbose=0, seed=0)
+        report_result({"rank": spec.index,
+                       "acc": hist.metrics["accuracy"][-1],
+                       "loss": hist.metrics["loss"][-1]})
+        """,
+    )
+    results = LocalLauncher().run([sys.executable, script], 2, timeout=300)
+    assert all(r.ok for r in results), [(r.index, r.error, r.log_tail[-500:]) for r in results]
+    accs = {r.value["acc"] for r in results}
+    losses = {r.value["loss"] for r in results}
+    assert len(accs) == 1 and len(losses) == 1  # replicas in lockstep
