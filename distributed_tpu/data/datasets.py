@@ -108,7 +108,14 @@ def synthetic_images(
     must share it (same class templates) while drawing different noise."""
     rng = np.random.default_rng(seed)
     trng = np.random.default_rng(seed if template_seed is None else template_seed)
-    templates = trng.uniform(0.0, 255.0, size=(num_classes,) + shape).astype(np.float32)
+    # Templates are generated at reduced spatial resolution and upsampled
+    # (nearest-neighbor): at ImageNet scale (1000 classes x 224x224x3) full-
+    # resolution templates plus smoothing temporaries would peak at multiple
+    # GB; 32x32 templates cost ~12MB and carry the same class signal.
+    h, w = shape[0], shape[1]
+    hs, ws = min(h, 32), min(w, 32)
+    small = (num_classes, hs, ws) + tuple(shape[2:])
+    templates = trng.uniform(0.0, 255.0, size=small).astype(np.float32)
     # Smooth the templates so convolutions have local structure to find, then
     # restore full contrast (smoothing alone collapses everything toward 127,
     # drowning the class signal in the pixel noise).
@@ -123,11 +130,31 @@ def synthetic_images(
     flat = templates.reshape(num_classes, -1)
     lo = flat.min(axis=1)[:, None]
     hi = flat.max(axis=1)[:, None]
-    templates = ((flat - lo) / np.maximum(hi - lo, 1e-6) * 255.0).reshape(templates.shape)
+    templates = ((flat - lo) / np.maximum(hi - lo, 1e-6) * 255.0).reshape(
+        templates.shape
+    )
+    row_idx = (np.arange(h) * hs) // h  # nearest-neighbor upsample indices
+    col_idx = (np.arange(w) * ws) // w
     y = rng.integers(0, num_classes, size=n)
-    x = templates[y] + rng.normal(0.0, 25.0, size=(n,) + shape).astype(np.float32)
-    x = np.clip(x, 0, 255).astype(np.uint8)
-    return x, y.astype(np.uint8)
+    # Materialize samples in chunks, upsampling after the label lookup:
+    # whole-set template lookup + noise would hold two full float32 copies
+    # of the dataset (and upsampling all class templates first would cost
+    # num_classes x full-res).
+    x = np.empty((n,) + tuple(shape), np.uint8)
+    # Budget ~128MB of float32 temporaries per chunk: each iteration holds
+    # ~3 float32 copies of the chunk (upsampled templates, noise draw, sum).
+    row_bytes = max(int(np.prod(shape)), 1) * 4 * 3
+    chunk = max(1, min(n, (1 << 27) // row_bytes))
+    for i in range(0, n, chunk):
+        yi = y[i : i + chunk]
+        t = templates[yi]
+        if (hs, ws) != (h, w):
+            t = t[:, row_idx][:, :, col_idx]
+        noisy = t + 25.0 * rng.standard_normal(
+            (len(yi),) + tuple(shape), dtype=np.float32
+        )
+        x[i : i + chunk] = np.clip(noisy, 0, 255).astype(np.uint8)
+    return x, y.astype(np.int32)
 
 
 def _synthetic_split(split, shape, num_classes, train_n, test_n, base_seed):
@@ -224,15 +251,51 @@ def load_cifar10(
     return _finalize(*got, normalize=normalize, channels=3)
 
 
+def load_imagenet(
+    split: str = "train",
+    *,
+    normalize: bool = True,
+    data_dir: Optional[str] = None,
+    synthetic_ok: bool = True,
+    image_size: int = 224,
+    num_classes: int = 1000,
+    synthetic_train_n: int = 1024,
+    synthetic_test_n: int = 256,
+) -> Arrays:
+    """ImageNet-scale loader (BASELINE.json configs[3]: ResNet-50 ImageNet
+    data-parallel). Resolution order: npz cache (``imagenet.npz`` with
+    x_train/y_train/x_test/y_test) else deterministic synthetic images at
+    ``image_size``. Synthetic defaults are intentionally small — this backs
+    input-pipeline/bench tests, not a real ImageNet epoch."""
+    dirs = _search_dirs(data_dir)
+    got = _try_npz(dirs, ["imagenet.npz", f"imagenet{image_size}.npz"], split)
+    if got is None:
+        if not synthetic_ok:
+            raise FileNotFoundError(
+                "ImageNet not found in " + ", ".join(map(str, dirs))
+            )
+        got = _synthetic_split(
+            split, (image_size, image_size, 3), num_classes,
+            synthetic_train_n, synthetic_test_n, 314159,
+        )
+    return _finalize(*got, normalize=normalize, channels=3)
+
+
 _LOADERS = {
     "mnist": load_mnist,
     "fashion_mnist": load_fashion_mnist,
     "cifar10": load_cifar10,
+    "imagenet": load_imagenet,
 }
 
 
 def load(name: str, split: str = "train", **kw) -> Arrays:
     try:
-        return _LOADERS[name](split, **kw)
+        loader = _LOADERS[name]
     except KeyError:
-        raise ValueError(f"Unknown dataset {name!r}; known: {sorted(_LOADERS)}") from None
+        raise ValueError(
+            f"Unknown dataset {name!r}; known: {sorted(_LOADERS)}"
+        ) from None
+    # Loader call outside the try: its own KeyErrors (e.g. a malformed npz
+    # cache missing x_test) must surface as themselves, not "unknown dataset".
+    return loader(split, **kw)

@@ -1,3 +1,11 @@
 from .cnn import cifar_cnn, mnist_cnn
+from .resnet import resnet, resnet18, resnet34, resnet50
 
-__all__ = ["mnist_cnn", "cifar_cnn"]
+__all__ = [
+    "mnist_cnn",
+    "cifar_cnn",
+    "resnet",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+]

@@ -1,0 +1,121 @@
+"""ResNet family (v1.5), NHWC, TPU-first.
+
+Build target from BASELINE.json configs[3]: "ResNet-50 ImageNet data-parallel
+... on v4-32". The reference itself has no ResNet (its only model is the
+2-conv MNIST CNN, /root/reference/README.md:58-68); this is the scale-out
+model the survey's build plan schedules after CNN parity (SURVEY.md §7 build
+order step 8).
+
+TPU notes:
+- All convs are bias-free + BatchNorm, NHWC/HWIO so XLA tiles them onto the
+  MXU; pass ``dtype=jnp.bfloat16`` for bf16 compute with float32 params.
+- v1.5 puts the stride on each bottleneck's 3x3 (not the 1x1), the variant
+  every published ImageNet baseline uses.
+- BatchNorm here is sync-BN by construction under data parallelism (the
+  batch-stat reductions become cross-replica collectives inside the jitted
+  step — see nn.layers.BatchNorm).
+- ``small_inputs=True`` swaps the 7x7/2+maxpool stem for a 3x3/1 stem, the
+  standard CIFAR adaptation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .. import nn
+
+# depth -> (block kind, blocks per stage)
+_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+    152: ("bottleneck", (3, 8, 36, 3)),
+}
+_STAGE_WIDTHS = (64, 128, 256, 512)
+
+
+def _conv_bn(filters, kernel, strides=1, activation=None, dtype=None):
+    layers = [
+        nn.Conv2D(filters, kernel, strides=strides, padding="same",
+                  use_bias=False, dtype=dtype),
+        nn.BatchNorm(),
+    ]
+    if activation is not None:
+        layers.append(nn.Activation(activation))
+    return layers
+
+
+def _projection(filters, strides, dtype):
+    return nn.Sequential(
+        _conv_bn(filters, 1, strides=strides, dtype=dtype), name="shortcut"
+    )
+
+
+def _basic_block(filters, strides, project, dtype):
+    main = nn.Sequential(
+        _conv_bn(filters, 3, strides=strides, activation="relu", dtype=dtype)
+        + _conv_bn(filters, 3, dtype=dtype),
+        name="main",
+    )
+    shortcut = _projection(filters, strides, dtype) if project else None
+    return nn.Residual(main, shortcut, activation="relu")
+
+
+def _bottleneck_block(filters, strides, project, dtype):
+    out = filters * 4
+    main = nn.Sequential(
+        _conv_bn(filters, 1, activation="relu", dtype=dtype)
+        + _conv_bn(filters, 3, strides=strides, activation="relu", dtype=dtype)  # v1.5
+        + _conv_bn(out, 1, dtype=dtype),
+        name="main",
+    )
+    shortcut = _projection(out, strides, dtype) if project else None
+    return nn.Residual(main, shortcut, activation="relu")
+
+
+def resnet(
+    depth: int = 50,
+    num_classes: int = 1000,
+    *,
+    small_inputs: bool = False,
+    stage_blocks: Optional[Sequence[int]] = None,
+    width: int = 64,
+    dtype=None,
+) -> nn.Sequential:
+    if depth not in _CONFIGS:
+        raise ValueError(f"Unsupported depth {depth}; known: {sorted(_CONFIGS)}")
+    kind, default_blocks = _CONFIGS[depth]
+    blocks = tuple(stage_blocks) if stage_blocks is not None else default_blocks
+    make = _basic_block if kind == "basic" else _bottleneck_block
+    expansion = 1 if kind == "basic" else 4
+
+    if small_inputs:  # CIFAR-style stem
+        layers = _conv_bn(width, 3, activation="relu", dtype=dtype)
+    else:  # ImageNet stem
+        layers = _conv_bn(width, 7, strides=2, activation="relu", dtype=dtype)
+        layers.append(nn.MaxPool2D(3, strides=2, padding="same"))
+
+    in_ch = width
+    for stage, n_blocks in enumerate(blocks):
+        filters = _STAGE_WIDTHS[stage] * width // 64
+        for b in range(n_blocks):
+            strides = 2 if (b == 0 and stage > 0) else 1
+            project = b == 0 and (strides != 1 or in_ch != filters * expansion)
+            layers.append(make(filters, strides, project, dtype))
+            in_ch = filters * expansion
+
+    layers += [nn.GlobalAvgPool2D(), nn.Dense(num_classes, dtype=dtype)]
+    return nn.Sequential(layers, name=f"resnet{depth}")
+
+
+def resnet18(num_classes: int = 1000, **kw) -> nn.Sequential:
+    return resnet(18, num_classes, **kw)
+
+
+def resnet34(num_classes: int = 1000, **kw) -> nn.Sequential:
+    return resnet(34, num_classes, **kw)
+
+
+def resnet50(num_classes: int = 1000, **kw) -> nn.Sequential:
+    return resnet(50, num_classes, **kw)

@@ -1,4 +1,4 @@
-from .core import Lambda, Layer, Sequential
+from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
     Activation,
     AvgPool2D,
@@ -16,6 +16,7 @@ from .layers import (
 __all__ = [
     "Layer",
     "Sequential",
+    "Residual",
     "Lambda",
     "Conv2D",
     "Dense",

@@ -118,6 +118,11 @@ class Sequential(Layer):
         layer.name = scope.assign(layer)
         self.layers.append(layer)
 
+    @property
+    def needs_rng(self) -> bool:
+        # Containers need an rng iff any child does (nested Dropout etc.).
+        return any(getattr(l, "needs_rng", False) for l in self.layers)
+
     def init(self, key, input_shape):
         params: Params = {}
         state: State = {}
@@ -163,6 +168,86 @@ class Sequential(Layer):
     def __repr__(self):
         inner = ", ".join(repr(l) for l in self.layers)
         return f"Sequential([{inner}])"
+
+
+class Residual(Layer):
+    """Skip connection: ``y = activation(main(x) + shortcut(x))``.
+
+    ``shortcut`` defaults to the identity. This is the non-sequential
+    composition primitive the ResNet family needs; both branches are ordinary
+    Layers (usually Sequentials), so the whole block still jits into one XLA
+    program with static dataflow — the add fuses into the preceding conv's
+    epilogue on TPU.
+    """
+
+    def __init__(self, main: Layer, shortcut: Optional[Layer] = None,
+                 activation=None, name: Optional[str] = None):
+        super().__init__(name)
+        from . import activations  # local import: core must not cycle
+
+        self.main = main
+        self.shortcut = shortcut
+        self.activation = activations.get(activation)
+        for branch, default in ((main, "main"), (shortcut, "shortcut")):
+            if branch is not None and branch.name is None:
+                branch.name = default
+
+    @property
+    def needs_rng(self) -> bool:
+        return any(
+            getattr(b, "needs_rng", False)
+            for b in (self.main, self.shortcut)
+            if b is not None
+        )
+
+    def init(self, key, input_shape):
+        k1, k2 = jax.random.split(key)
+        pm, sm, out_main = self.main.init(k1, tuple(input_shape))
+        if self.shortcut is not None:
+            ps, ss, out_sc = self.shortcut.init(k2, tuple(input_shape))
+        else:
+            ps, ss, out_sc = {}, {}, tuple(input_shape)
+        if out_main != out_sc:
+            raise ValueError(
+                f"Residual branch shapes differ: main {out_main} vs "
+                f"shortcut {out_sc} (add a projection shortcut)"
+            )
+        params = {"main": pm}
+        state = {"main": sm} if sm else {}
+        if ps:
+            params["shortcut"] = ps
+        if ss:
+            state["shortcut"] = ss
+        return params, state, out_main
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        rngs = (
+            jax.random.split(rng, 2) if rng is not None else (None, None)
+        )
+        main_rng = rngs[0] if getattr(self.main, "needs_rng", False) else None
+        y, sm = self.main.apply(
+            params.get("main", {}), state.get("main", {}), x,
+            train=train, rng=main_rng,
+        )
+        if self.shortcut is not None:
+            sc_rng = rngs[1] if getattr(self.shortcut, "needs_rng", False) else None
+            sc, ss = self.shortcut.apply(
+                params.get("shortcut", {}), state.get("shortcut", {}), x,
+                train=train, rng=sc_rng,
+            )
+        else:
+            sc, ss = x, {}
+        new_state = {}
+        if sm:
+            new_state["main"] = sm
+        if ss:
+            new_state["shortcut"] = ss
+        return self.activation(y + sc), new_state
+
+    def __repr__(self):
+        return (
+            f"Residual(main={self.main!r}, shortcut={self.shortcut!r})"
+        )
 
 
 class Lambda(Layer):

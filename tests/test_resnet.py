@@ -1,0 +1,151 @@
+"""ResNet family: residual composition, shapes, param counts, training.
+
+The reference has no ResNet; these tests cover the scale-out model target
+(BASELINE.json configs[3], SURVEY.md §7 build-order step 8) and the Residual
+composition primitive the family is built from.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+class TestResidual:
+    def test_identity_shortcut(self):
+        main = nn.Sequential([nn.Lambda(lambda x: 2.0 * x)])
+        block = nn.Residual(main)
+        params, state, out = block.init(jax.random.PRNGKey(0), (4,))
+        assert out == (4,)
+        x = jnp.arange(8.0).reshape(2, 4)
+        y, _ = block.apply(params, state, x)
+        np.testing.assert_allclose(y, 3.0 * x)
+
+    def test_activation_applied_after_add(self):
+        main = nn.Sequential([nn.Lambda(lambda x: -2.0 * x)])
+        block = nn.Residual(main, activation="relu")
+        params, state, _ = block.init(jax.random.PRNGKey(0), (3,))
+        x = jnp.ones((2, 3))
+        y, _ = block.apply(params, state, x)
+        np.testing.assert_allclose(y, 0.0)  # relu(x - 2x) = relu(-x) = 0
+
+    def test_shape_mismatch_raises(self):
+        main = nn.Sequential([nn.Dense(7)])
+        with pytest.raises(ValueError, match="projection"):
+            nn.Residual(main).init(jax.random.PRNGKey(0), (4,))
+
+    def test_projection_shortcut(self):
+        main = nn.Sequential([nn.Dense(7)])
+        block = nn.Residual(main, shortcut=nn.Sequential([nn.Dense(7)]))
+        params, state, out = block.init(jax.random.PRNGKey(0), (4,))
+        assert out == (7,)
+        assert "shortcut" in params
+        y, _ = block.apply(params, state, jnp.ones((2, 4)))
+        assert y.shape == (2, 7)
+
+    def test_batchnorm_state_threads_through(self):
+        main = nn.Sequential([nn.Dense(4), nn.BatchNorm()])
+        block = nn.Residual(main)
+        params, state, _ = block.init(jax.random.PRNGKey(0), (4,))
+        x = jnp.ones((8, 4))
+        _, new_state = block.apply(params, state, x, train=True)
+        assert "main" in new_state  # BN running stats propagate out
+
+    def test_nested_dropout_gets_rng(self):
+        # Regression: containers must report needs_rng for nested children.
+        inner = nn.Sequential([nn.Dense(4), nn.Dropout(0.5)])
+        outer = nn.Sequential([inner, nn.Dense(2)])
+        assert outer.needs_rng
+        params, state, _ = outer.init(jax.random.PRNGKey(0), (4,))
+        y, _ = outer.apply(
+            params, state, jnp.ones((2, 4)), train=True,
+            rng=jax.random.PRNGKey(1),
+        )
+        assert y.shape == (2, 2)
+
+    def test_residual_dropout_gets_rng(self):
+        main = nn.Sequential([nn.Dense(4), nn.Dropout(0.5)])
+        block = nn.Residual(main)
+        assert block.needs_rng
+        params, state, _ = block.init(jax.random.PRNGKey(0), (4,))
+        y, _ = block.apply(
+            params, state, jnp.ones((2, 4)), train=True,
+            rng=jax.random.PRNGKey(1),
+        )
+        assert y.shape == (2, 4)
+
+
+class TestResNet:
+    def test_resnet50_param_count(self):
+        # Published torchvision/keras ResNet-50 v1.5 count.
+        module = dtpu.models.resnet50(num_classes=1000)
+        params, _, out = module.init(jax.random.PRNGKey(0), (224, 224, 3))
+        assert out == (1000,)
+        from distributed_tpu.utils.tree import tree_size
+
+        assert tree_size(params) == 25_557_032
+
+    def test_resnet18_param_count(self):
+        module = dtpu.models.resnet18(num_classes=1000)
+        params, _, _ = module.init(jax.random.PRNGKey(0), (224, 224, 3))
+        from distributed_tpu.utils.tree import tree_size
+
+        assert tree_size(params) == 11_689_512
+
+    def test_small_inputs_forward(self):
+        module = dtpu.models.resnet18(num_classes=10, small_inputs=True)
+        params, state, out = module.init(jax.random.PRNGKey(0), (32, 32, 3))
+        assert out == (10,)
+        x = jnp.zeros((2, 32, 32, 3))
+        logits, _ = module.apply(params, state, x, train=False)
+        assert logits.shape == (2, 10)
+
+    def test_tiny_resnet_trains_dp(self, devices):
+        # 1-block-per-stage bottleneck net on the 8-device mesh: the full
+        # fit path (BN state, residual params, DP sharding) in one test.
+        mesh = dtpu.make_mesh({"data": 8}, devices=devices)
+        strategy = dtpu.DataParallel(mesh=mesh)
+        with strategy.scope():
+            model = dtpu.Model(
+                dtpu.models.resnet(
+                    50, num_classes=4, small_inputs=True,
+                    stage_blocks=(1, 1, 1, 1), width=16,
+                )
+            )
+            model.compile(
+                optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"],
+            )
+        x, y = dtpu.data.synthetic_images(256, (16, 16, 3), 4, seed=7)
+        x = x.astype(np.float32) / 255.0
+        hist = model.fit(x, y, batch_size=64, epochs=3, verbose=0, seed=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+        # Replicas stay synchronized (the reference's key invariant,
+        # /root/reference/README.md:226-232).
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+            for s in shards[1:]:
+                np.testing.assert_array_equal(shards[0], s)
+
+    def test_bf16_forward(self):
+        module = dtpu.models.resnet18(
+            num_classes=10, small_inputs=True, dtype=jnp.bfloat16
+        )
+        params, state, _ = module.init(jax.random.PRNGKey(0), (32, 32, 3))
+        logits, _ = module.apply(
+            params, state, jnp.zeros((2, 32, 32, 3)), train=False
+        )
+        assert logits.shape == (2, 10)
+
+
+class TestImagenetLoader:
+    def test_synthetic_imagenet(self):
+        x, y = dtpu.data.load_imagenet(
+            "train", image_size=64, synthetic_train_n=64, num_classes=1000
+        )
+        assert x.shape == (64, 64, 64, 3) and x.dtype == np.float32
+        assert y.dtype == np.int32 and y.max() >= 256  # labels beyond uint8
