@@ -25,8 +25,9 @@ Quickstart (the reference's local->distributed 6-line-diff contract):
     model.fit(x, y, batch_size=64 * strategy.num_replicas_in_sync, epochs=3)
 """
 
-from . import cluster, data, models, nn, ops, optim, parallel
+from . import cluster, data, models, nn, ops, optim, parallel, utils
 from .checkpoint import Checkpointer, export_hdf5, import_hdf5
+from .training import callbacks
 from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
@@ -61,5 +62,7 @@ __all__ = [
     "data",
     "parallel",
     "cluster",
+    "utils",
+    "callbacks",
     "__version__",
 ]

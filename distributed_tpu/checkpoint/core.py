@@ -214,6 +214,16 @@ class Checkpointer:
                 pass
 
     def restore_into(self, model, step: Optional[int] = None) -> int:
+        """Restore params/state/opt_state + step cursor.
+
+        Multi-host: saves are chief-only, so on a gang whose checkpoint
+        directory is NOT a shared filesystem only process 0 has the files.
+        The chief therefore decides which step to restore and broadcasts the
+        restored values to every process — all processes always make the
+        same decision and end with identical state, keeping the SPMD gang's
+        collective schedules in lockstep."""
+        if jax.process_count() > 1:
+            return self._restore_multihost(model, step)
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"No checkpoints in {self.directory}")
@@ -233,4 +243,104 @@ class Checkpointer:
             )
         model.step = int(meta["step"])
         model._seed = int(meta.get("seed", model._seed))
+        return model.step
+
+    def _restore_multihost(self, model, step: Optional[int]) -> int:
+        from jax.experimental import multihost_utils
+
+        if not (model.built and model.compiled):
+            raise RuntimeError(
+                "Multi-host restore needs a built+compiled model: the "
+                "non-chief processes take array shapes from the live model "
+                "(fit() builds before callbacks run, so ModelCheckpoint"
+                "(restore=True) satisfies this automatically)"
+            )
+        chief = jax.process_index() == 0
+        opt_template = model.tx.init(model.params)
+        n_p = len(jax.tree_util.tree_leaves(model.params))
+        n_s = len(jax.tree_util.tree_leaves(model.state or {}))
+        n_o = len(jax.tree_util.tree_leaves(opt_template))
+
+        # Header broadcast first so every process agrees on BOTH the step
+        # and the value-broadcast *structure* before any array collective —
+        # a structure mismatch across processes would hang the gang.
+        local = self.latest_step() if step is None else step
+        if chief and local is not None:
+            tree, meta = load_npz(self._path(local))
+            ck_p = len(jax.tree_util.tree_leaves(tree["params"]))
+            ck_s = len(jax.tree_util.tree_leaves(tree.get("state") or {}))
+            ck_o = len(jax.tree_util.tree_leaves(tree.get("opt_state")))
+            header = np.array(
+                [local, int(meta.get("seed", model._seed)), ck_p, ck_s, ck_o],
+                np.int64,
+            )
+        else:
+            tree = None
+            header = np.array([-1, 0, 0, 0, 0], np.int64)
+        header = multihost_utils.broadcast_one_to_all(header)
+        agreed, seed, ck_p, ck_s, ck_o = (int(v) for v in header)
+        if agreed < 0:
+            raise FileNotFoundError(f"No checkpoints in {self.directory}")
+        if ck_p != n_p:
+            raise RuntimeError(
+                f"Checkpoint step {agreed} has {ck_p} param tensors but the "
+                f"model has {n_p} — wrong model for this checkpoint"
+            )
+        if ck_s not in (0, n_s):
+            raise RuntimeError(
+                f"Checkpoint step {agreed} has {ck_s} state tensors but the "
+                f"model has {n_s}"
+            )
+        # ck_o == 0 (saved uncompiled) keeps the fresh optimizer init, like
+        # the single-host path; any other mismatch is a different optimizer.
+        if ck_o not in (0, n_o):
+            raise RuntimeError(
+                f"Checkpoint step {agreed} has {ck_o} optimizer tensors but "
+                f"the model's optimizer has {n_o}"
+            )
+
+        def zeros_of(tree_):
+            return [
+                np.zeros(l.shape, l.dtype)
+                for l in jax.tree_util.tree_leaves(tree_)
+            ]
+
+        if chief:
+            p_leaves = [
+                np.asarray(l)
+                for l in jax.tree_util.tree_leaves(tree["params"])
+            ]
+            s_leaves = (
+                [np.asarray(l)
+                 for l in jax.tree_util.tree_leaves(tree.get("state") or {})]
+                if ck_s else []
+            )
+            o_leaves = (
+                [np.asarray(l)
+                 for l in jax.tree_util.tree_leaves(tree.get("opt_state"))]
+                if ck_o else []
+            )
+        else:
+            p_leaves = zeros_of(model.params)
+            s_leaves = zeros_of(model.state or {}) if ck_s else []
+            o_leaves = zeros_of(opt_template) if ck_o else []
+        p_leaves, s_leaves, o_leaves = multihost_utils.broadcast_one_to_all(
+            (p_leaves, s_leaves, o_leaves)
+        )
+
+        def graft(template, leaves):
+            treedef = jax.tree_util.tree_structure(template)
+            return jax.tree_util.tree_unflatten(treedef, list(leaves))
+
+        model.params = model.strategy.put_params(graft(model.params, p_leaves))
+        if ck_s:
+            model.state = model.strategy.put_params(
+                graft(model.state, s_leaves)
+            )
+        if ck_o:
+            model.opt_state = model.strategy.put_params(
+                graft(opt_template, o_leaves)
+            )
+        model.step = agreed
+        model._seed = seed
         return model.step

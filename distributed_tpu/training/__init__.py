@@ -1,4 +1,5 @@
+from . import callbacks
 from .history import History
 from .model import Model
 
-__all__ = ["Model", "History"]
+__all__ = ["Model", "History", "callbacks"]

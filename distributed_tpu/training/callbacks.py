@@ -1,0 +1,233 @@
+"""Training callbacks.
+
+The reference's captured logs call out exactly what is missing from its own
+training loop: "ModelCheckpoint callback is not provided. Workers will need
+to restart training if any fails" (/root/reference/README.md:400). This
+module supplies that callback (periodic checkpoints + resume) and the other
+loop-control hooks a Keras-shaped ``fit`` is expected to have.
+
+All side effects (file writes, logs) are chief-only; every process still
+executes the same control flow, so callbacks never desynchronize an SPMD
+gang. EarlyStopping decides from epoch logs that are already all-reduced
+(identical on every process), so all processes stop on the same epoch.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..checkpoint import Checkpointer
+from ..utils import logging as dlog
+
+
+class Callback:
+    """Hook points around the training loop (all optional)."""
+
+    def on_train_begin(self, model):
+        pass
+
+    def on_epoch_begin(self, model, epoch: int):
+        pass
+
+    def on_batch_end(self, model, step: int, logs: dict):
+        """After each optimizer step. ``logs['loss']`` is a *device* scalar;
+        reading it forces a host sync, so fast callbacks should not touch it
+        every step."""
+
+    def on_epoch_end(self, model, epoch: int, logs: dict):
+        pass
+
+    def on_train_end(self, model, history):
+        pass
+
+
+class ModelCheckpoint(Callback):
+    """Periodic step-tagged checkpoints via ``Checkpointer``; closes the
+    reference's restart-from-scratch gap (README.md:400).
+
+    ``save_freq='epoch'`` saves every epoch end; an int saves every N
+    optimizer steps. ``restore=True`` resumes from the latest checkpoint in
+    the directory at train begin (no-op when the directory is empty), making
+    crash-restart a relaunch of the identical command.
+    """
+
+    def __init__(self, directory, *, save_freq="epoch", keep: int = 3,
+                 restore: bool = False):
+        self.ckpt = Checkpointer(directory, keep=keep)
+        if save_freq != "epoch" and not (
+            isinstance(save_freq, int) and save_freq > 0
+        ):
+            raise ValueError("save_freq must be 'epoch' or a positive int")
+        self.save_freq = save_freq
+        self.restore = restore
+
+    def on_train_begin(self, model):
+        if not self.restore:
+            return
+        has_ckpt = self.ckpt.latest_step() is not None
+        if jax.process_count() > 1:
+            # Collective decision: without a shared filesystem only the
+            # chief sees the (chief-only-written) checkpoints; every process
+            # must agree on whether to restore or the gang's collective
+            # schedules diverge. restore_into then broadcasts the values.
+            from jax.experimental import multihost_utils
+
+            has_ckpt = bool(
+                multihost_utils.broadcast_one_to_all(np.bool_(has_ckpt))
+            )
+        if has_ckpt:
+            step = self.ckpt.restore_into(model)
+            # fit() reads this to skip already-completed epochs, so an
+            # identical relaunch completes to `epochs` instead of training
+            # `epochs` more (the crash-restart contract).
+            model._resumed_step = step
+            if jax.process_index() == 0:
+                dlog.info(f"ModelCheckpoint: resumed from step {step}")
+
+    def on_batch_end(self, model, step, logs):
+        if isinstance(self.save_freq, int) and step % self.save_freq == 0:
+            self.ckpt.save(model)
+
+    def on_epoch_end(self, model, epoch, logs):
+        if self.save_freq == "epoch":
+            self.ckpt.save(model)
+
+
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving.
+
+    Decisions use the epoch-end logs, which are aggregated across replicas
+    before any process sees them — so the stop is collective-safe.
+    """
+
+    def __init__(self, monitor: str = "loss", *, patience: int = 0,
+                 min_delta: float = 0.0, mode: str = "auto",
+                 restore_best: bool = False):
+        self.monitor = monitor
+        self.patience = int(patience)
+        self.min_delta = abs(float(min_delta))
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto/min/max, got {mode!r}")
+        if mode == "auto":
+            mode = "max" if ("acc" in monitor or monitor.endswith("auc")) else "min"
+        self.mode = mode
+        self.restore_best = restore_best
+        self._best = math.inf if mode == "min" else -math.inf
+        self._wait = 0
+        self._best_params = None
+        self._best_state = None
+
+    def _improved(self, value: float) -> bool:
+        if self.mode == "min":
+            return value < self._best - self.min_delta
+        return value > self._best + self.min_delta
+
+    def on_epoch_end(self, model, epoch, logs):
+        if self.monitor not in logs:
+            dlog.warning(
+                f"EarlyStopping: metric {self.monitor!r} not in logs "
+                f"{sorted(logs)}; skipping"
+            )
+            return
+        value = float(logs[self.monitor])
+        if self._improved(value):
+            self._best = value
+            self._wait = 0
+            if self.restore_best:
+                # Deep host copies: the jitted train step DONATES param/state
+                # buffers, so stashing by reference would hold deleted arrays
+                # after the next step.
+                copy = lambda t: jax.tree_util.tree_map(
+                    lambda a: np.array(jax.device_get(a)), t
+                )
+                self._best_params = copy(model.params)
+                self._best_state = copy(model.state)
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                model.stop_training = True
+                if jax.process_index() == 0:
+                    dlog.info(
+                        f"EarlyStopping: no {self.monitor} improvement for "
+                        f"{self._wait} epochs (best {self._best:.4f})"
+                    )
+
+    def on_train_end(self, model, history):
+        if self.restore_best and self._best_params is not None:
+            model.params = model.strategy.put_params(self._best_params)
+            model.state = model.strategy.put_params(self._best_state)
+
+
+class CSVLogger(Callback):
+    """Append epoch logs to a CSV file (chief-only)."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self._keys = None
+
+    def on_epoch_end(self, model, epoch, logs):
+        if jax.process_index() != 0:
+            return
+        if self._keys is None:
+            self._keys = sorted(logs)
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            if not self.path.exists():
+                self.path.write_text("epoch," + ",".join(self._keys) + "\n")
+        row = [str(epoch)] + [
+            repr(float(logs.get(k, float("nan")))) for k in self._keys
+        ]
+        with open(self.path, "a") as f:
+            f.write(",".join(row) + "\n")
+
+
+class LambdaCallback(Callback):
+    """Ad-hoc hooks without subclassing."""
+
+    def __init__(self, on_train_begin=None, on_epoch_begin=None,
+                 on_batch_end=None, on_epoch_end=None, on_train_end=None):
+        self._hooks = {
+            "on_train_begin": on_train_begin,
+            "on_epoch_begin": on_epoch_begin,
+            "on_batch_end": on_batch_end,
+            "on_epoch_end": on_epoch_end,
+            "on_train_end": on_train_end,
+        }
+
+    def __getattribute__(self, name):
+        if name.startswith("on_"):
+            hook = object.__getattribute__(self, "_hooks").get(name)
+            if hook is not None:
+                return hook
+        return object.__getattribute__(self, name)
+
+
+class ProfilerCallback(Callback):
+    """Capture a ``jax.profiler`` trace over a step window; view in
+    TensorBoard/XProf. The TPU-native answer to the reference's
+    log-line-only observability (SURVEY.md §5 tracing)."""
+
+    def __init__(self, logdir, *, start_step: int = 10, num_steps: int = 5):
+        self.logdir = str(logdir)
+        self.start_step = int(start_step)
+        self.stop_step = int(start_step) + int(num_steps)
+        self._active = False
+
+    def on_batch_end(self, model, step, logs):
+        if jax.process_index() != 0:  # chief-only, one trace per gang
+            return
+        if not self._active and step >= self.start_step and step < self.stop_step:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_train_end(self, model, history):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

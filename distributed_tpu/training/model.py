@@ -81,6 +81,8 @@ class Model:
         self.compiled = False
         self.input_shape: Optional[Tuple[int, ...]] = None
         self.step = 0  # global optimizer step (checkpoint/resume cursor)
+        self.stop_training = False  # callbacks (EarlyStopping) set this
+        self._resumed_step = None  # set by a restoring ModelCheckpoint
         self._seed = 0
         self._train_step = None
         self._eval_step = None
@@ -194,7 +196,7 @@ class Model:
     def fit(
         self,
         x,
-        y,
+        y=None,
         batch_size: int = 32,
         epochs: int = 1,
         steps_per_epoch: Optional[int] = None,
@@ -205,31 +207,105 @@ class Model:
         seed: Optional[int] = None,
         callbacks: Sequence = (),
     ) -> History:
-        x = np.asarray(x)
-        y = np.asarray(y)
         if not self.compiled:
             raise RuntimeError("Call compile() before fit()")
-        if not self.built:
-            self.build(x.shape[1:], seed=0 if seed is None else seed)
-        n = x.shape[0]
-        if batch_size > n:
-            raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+        if y is None:
+            # Iterator mode: x yields (x_batch, y_batch) — e.g. a
+            # dtpu.data.Pipeline whose native threads prefetch batches ahead
+            # of the device. batch_size/steps come from the source.
+            if not hasattr(x, "__next__"):
+                raise ValueError(
+                    "fit(x) without y requires a batch iterator "
+                    "(e.g. distributed_tpu.data.Pipeline)"
+                )
+            source = x
+            batch_size = getattr(source, "batch_size", batch_size)
+            if steps_per_epoch is None:
+                steps_per_epoch = getattr(source, "steps_per_pass", None)
+                if steps_per_epoch is None:
+                    raise ValueError(
+                        "steps_per_epoch is required with a plain iterator"
+                    )
+            if not self.built:
+                bshape = getattr(source, "batch_shape", None)
+                if bshape is None:
+                    raise RuntimeError(
+                        "Build the model first (model.build(input_shape)) "
+                        "when fitting from an iterator without batch_shape"
+                    )
+                self.build(tuple(bshape[1:]), seed=0 if seed is None else seed)
+
+            def next_batch():
+                return next(source)
+
+        else:
+            x = np.asarray(x)
+            y = np.asarray(y)
+            if not self.built:
+                self.build(x.shape[1:], seed=0 if seed is None else seed)
+            n = x.shape[0]
+            if batch_size > n:
+                raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+            if steps_per_epoch is None:
+                steps_per_epoch = n // batch_size
         self.strategy.local_batch_size(batch_size)  # divisibility check
-        if steps_per_epoch is None:
-            steps_per_epoch = n // batch_size
         step_fn = self._get_train_step()
         history = History()
-        stream = _index_stream(n, batch_size, shuffle, seed, start_step=self.step)
         is_chief = jax.process_index() == 0
+        self.stop_training = False
+        self._resumed_step = None
         for cb in callbacks:
             cb.on_train_begin(self)
+        if y is not None:
+            # After on_train_begin: a restoring ModelCheckpoint may have
+            # advanced self.step, and the stream must fast-forward past
+            # consumed batches.
+            stream = _index_stream(
+                n, batch_size, shuffle, seed, start_step=self.step
+            )
+
+            def next_batch():
+                idx = next(stream)
+                return x[idx], y[idx]
+
+        # Crash-restart contract: when a callback restored a checkpoint and
+        # the caller didn't pass initial_epoch, `epochs` is the *total*
+        # target — skip the epochs (and intra-epoch steps) already done, so
+        # relaunching the identical command completes the run instead of
+        # training `epochs` more. Assumes the relaunch uses the same
+        # batch_size/steps_per_epoch, which "identical command" guarantees.
+        resume_offset = 0
+        if self._resumed_step is not None and initial_epoch == 0:
+            initial_epoch, resume_offset = divmod(
+                self._resumed_step, steps_per_epoch
+            )
+            if y is None:
+                # The array path fast-forwards via _index_stream(start_step);
+                # an iterator source must be advanced too or the resumed run
+                # retrains on already-consumed batches.
+                emitted = getattr(source, "steps_emitted", None)
+                if emitted is not None:
+                    for _ in range(max(0, self._resumed_step - emitted)):
+                        next(source)
+                else:
+                    dlog.warning(
+                        "Resuming from a plain iterator: cannot fast-forward "
+                        "the data source; batch alignment with the restored "
+                        f"step ({self._resumed_step}) is the caller's "
+                        "responsibility"
+                    )
+            self._resumed_step = None
         for epoch in range(initial_epoch, epochs):
             t0 = time.perf_counter()
+            for cb in callbacks:
+                cb.on_epoch_begin(self, epoch)
             losses = []
             msums: Dict[str, list] = {name: [] for name, _ in self.metric_fns}
-            for _ in range(steps_per_epoch):
-                idx = next(stream)
-                batch = self.strategy.put_batch({"x": x[idx], "y": y[idx]})
+            epoch_steps = steps_per_epoch - resume_offset
+            resume_offset = 0
+            for _ in range(epoch_steps):
+                xb, yb = next_batch()
+                batch = self.strategy.put_batch({"x": xb, "y": yb})
                 rng = self._step_rng()
                 self.params, self.state, self.opt_state, loss, mvals = step_fn(
                     self.params, self.state, self.opt_state,
@@ -239,6 +315,8 @@ class Model:
                 losses.append(loss)
                 for name, _ in self.metric_fns:
                     msums[name].append(mvals[name])
+                for cb in callbacks:
+                    cb.on_batch_end(self, self.step, {"loss": loss})
             # One host sync per epoch.
             logs = {"loss": float(np.mean(jax.device_get(losses)))}
             for name, pairs in msums.items():
@@ -256,13 +334,19 @@ class Model:
             history.record(epoch, logs)
             for cb in callbacks:
                 cb.on_epoch_end(self, epoch, logs)
+            if self.stop_training:
+                epochs = epoch + 1  # for the verbose epoch counter below
             if verbose and is_chief:
-                samples = batch_size * steps_per_epoch
+                # epoch_steps, not steps_per_epoch: a resumed partial epoch
+                # runs fewer steps and must report what actually ran.
+                samples = batch_size * epoch_steps
                 parts = " - ".join(f"{k}: {v:.4f}" for k, v in logs.items())
                 dlog.info(
                     f"Epoch {epoch + 1}/{epochs} - {samples} samples - "
-                    f"{dt:.2f}s ({dt / steps_per_epoch * 1000:.1f}ms/step) - {parts}"
+                    f"{dt:.2f}s ({dt / epoch_steps * 1000:.1f}ms/step) - {parts}"
                 )
+            if self.stop_training:
+                break
         for cb in callbacks:
             cb.on_train_end(self, history)
         return history

@@ -1,3 +1,3 @@
-from . import logging, tree
+from . import logging, profiler, tree
 
-__all__ = ["logging", "tree"]
+__all__ = ["logging", "profiler", "tree"]

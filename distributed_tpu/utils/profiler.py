@@ -1,0 +1,71 @@
+"""Profiling and tracing hooks.
+
+The reference's observability is log lines and a progress bar
+(/root/reference/README.md:395-412); SURVEY.md §5 schedules the TPU-native
+upgrade: ``jax.profiler`` trace capture (device timelines, XLA HLO, memory)
+plus structured step events. Traces are chief-only so an SPMD gang produces
+one trace directory, and are viewable in TensorBoard / XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+from . import logging as dlog
+
+
+@contextlib.contextmanager
+def trace(logdir: str, *, chief_only: bool = True):
+    """Capture a profiler trace for the duration of the block.
+
+        with dtpu.utils.profiler.trace("/tmp/trace"):
+            model.fit(...)
+    """
+    active = not (chief_only and jax.process_index() != 0)
+    if active:
+        jax.profiler.start_trace(str(logdir))
+    try:
+        yield
+    finally:
+        if active:
+            jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the trace timeline (host + device)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Steps/sec measurement with warmup exclusion; emits structured events.
+
+    Used standalone around a custom loop, or via `report()` for one-line
+    telemetry. Warmup steps (compile) are excluded from the rate.
+    """
+
+    def __init__(self, warmup: int = 1):
+        self.warmup = int(warmup)
+        self.steps = 0
+        self._t0 = None
+
+    def tick(self):
+        self.steps += 1
+        if self.steps == self.warmup:
+            self._t0 = time.perf_counter()
+
+    @property
+    def steps_per_sec(self) -> float:
+        counted = self.steps - self.warmup
+        if self._t0 is None or counted <= 0:
+            return 0.0
+        return counted / (time.perf_counter() - self._t0)
+
+    def report(self, **extra):
+        rate = self.steps_per_sec
+        if jax.process_index() == 0:
+            dlog.event("step_rate", steps_per_sec=rate, steps=self.steps, **extra)
+            dlog.info(f"{rate:.2f} steps/s over {self.steps - self.warmup} steps")
+        return rate
