@@ -1,0 +1,24 @@
+# Dataset loaders, mirroring keras::dataset_mnist() (reference README.md:51)
+# but returning data already in NHWC float form — the reference's manual
+# array_reshape + /255 steps (README.md:53-56) are folded in by default.
+
+.load_split <- function(name, normalize) {
+  d <- dtpu()$data$load(name, "train", normalize = normalize)
+  t <- dtpu()$data$load(name, "test", normalize = normalize)
+  list(
+    train = list(x = d[[1]], y = d[[2]]),
+    test = list(x = t[[1]], y = t[[2]])
+  )
+}
+
+#' MNIST in the keras dataset_mnist() shape: list(train=list(x,y), test=...).
+#' @export
+dataset_mnist <- function(normalize = TRUE) .load_split("mnist", normalize)
+
+#' @export
+dataset_fashion_mnist <- function(normalize = TRUE) {
+  .load_split("fashion_mnist", normalize)
+}
+
+#' @export
+dataset_cifar10 <- function(normalize = TRUE) .load_split("cifar10", normalize)

@@ -1,0 +1,144 @@
+# Keras-shaped model API: build %>% compile %>% fit, mirroring the
+# reference's R trainer (README.md:58-75, 118-154) on the TPU backend.
+
+#' The reference's exact MNIST CNN (README.md:58-68).
+#' @export
+mnist_cnn <- function(num_classes = 10L) {
+  dtpu()$models$mnist_cnn(num_classes = as.integer(num_classes))
+}
+
+#' @export
+cifar_cnn <- function(num_classes = 10L) {
+  dtpu()$models$cifar_cnn(num_classes = as.integer(num_classes))
+}
+
+#' @export
+resnet50 <- function(num_classes = 1000L, small_inputs = FALSE) {
+  dtpu()$models$resnet50(num_classes = as.integer(num_classes),
+                         small_inputs = small_inputs)
+}
+
+#' Wrap a module into a trainable model. Call inside with_strategy_scope()
+#' to distribute (scope-wraps-construction, README.md:134).
+#' @export
+dtpu_model <- function(module, name = NULL) {
+  m <- dtpu()$Model(module, name = name)
+  class(m) <- c("dtpu_model", class(m))
+  m
+}
+
+#' @export
+compile <- function(object, ...) UseMethod("compile")
+
+#' Configure loss/optimizer/metrics (README.md:70-73, 145-151).
+#' @export
+compile.dtpu_model <- function(object,
+                               optimizer = "sgd",
+                               loss = "sparse_categorical_crossentropy",
+                               metrics = c("accuracy"),
+                               learning_rate = NULL,
+                               ...) {
+  if (!is.null(learning_rate) && is.character(optimizer)) {
+    optimizer <- dtpu()$optim$get(optimizer,
+                                  learning_rate = as.numeric(learning_rate))
+  }
+  object$compile(optimizer = optimizer, loss = loss,
+                 metrics = as.list(metrics), ...)
+  invisible(object)
+}
+
+#' @export
+fit <- function(object, ...) UseMethod("fit")
+
+#' Train; returns a history whose metrics are R vectors
+#' (`result$metrics$accuracy`, the shape the reference's Spark closure reads
+#' at README.md:220).
+#' @export
+fit.dtpu_model <- function(object, x, y,
+                           batch_size = 32L,
+                           epochs = 1L,
+                           steps_per_epoch = NULL,
+                           validation_data = NULL,
+                           verbose = 1L,
+                           callbacks = list(),
+                           ...) {
+  h <- object$fit(
+    x, y,
+    batch_size = as.integer(batch_size),
+    epochs = as.integer(epochs),
+    steps_per_epoch = if (is.null(steps_per_epoch)) NULL
+                      else as.integer(steps_per_epoch),
+    validation_data = validation_data,
+    verbose = as.integer(verbose),
+    callbacks = callbacks,
+    ...
+  )
+  hist <- list(metrics = lapply(h$history, unlist), model = object)
+  class(hist) <- "dtpu_history"
+  hist
+}
+
+#' @export
+print.dtpu_history <- function(x, ...) {
+  for (k in names(x$metrics)) {
+    cat(k, ": ", paste(signif(x$metrics[[k]], 4), collapse = " "), "\n",
+        sep = "")
+  }
+  invisible(x)
+}
+
+#' @export
+evaluate <- function(object, ...) UseMethod("evaluate")
+
+#' @export
+evaluate.dtpu_model <- function(object, x, y, batch_size = 32L, ...) {
+  res <- object$evaluate(x, y, batch_size = as.integer(batch_size), ...)
+  lapply(res, as.numeric)
+}
+
+#' @export
+predict_on_batch <- function(object, x, batch_size = 32L) {
+  object$predict(x, batch_size = as.integer(batch_size))
+}
+
+#' @export
+summary_model <- function(object) object$summary()
+
+#' Save trained weights as HDF5 — the reference's model-exchange format
+#' (save_model_hdf5, README.md:237). Rank-0-only under SPMD.
+#' @export
+save_model_hdf5 <- function(object, filepath) {
+  dtpu()$export_hdf5(filepath, object$params)
+  invisible(filepath)
+}
+
+#' Load HDF5 weights into a built model.
+#' @export
+load_model_hdf5 <- function(object, filepath) {
+  loaded <- dtpu()$import_hdf5(filepath)
+  object$params <- object$strategy$put_params(loaded[[1]])
+  invisible(object)
+}
+
+# ---- callbacks ------------------------------------------------------------
+
+#' Periodic checkpoints + crash-restart resume (the capability the
+#' reference's own logs flag as missing, README.md:400).
+#' @export
+model_checkpoint_callback <- function(directory, save_freq = "epoch",
+                                      keep = 3L, restore = FALSE) {
+  if (is.numeric(save_freq)) save_freq <- as.integer(save_freq)
+  dtpu()$callbacks$ModelCheckpoint(directory, save_freq = save_freq,
+                                   keep = as.integer(keep), restore = restore)
+}
+
+#' @export
+early_stopping_callback <- function(monitor = "loss", patience = 0L,
+                                    min_delta = 0) {
+  dtpu()$callbacks$EarlyStopping(monitor = monitor,
+                                 patience = as.integer(patience),
+                                 min_delta = as.numeric(min_delta))
+}
+
+#' @export
+csv_logger_callback <- function(path) dtpu()$callbacks$CSVLogger(path)

@@ -1,0 +1,58 @@
+# Package bootstrap: the reticulate bridge to the Python `distributed_tpu`
+# package. Mirrors the role the R `tensorflow`/`keras` packages play in the
+# reference (every `tf$...` call proxies into Python over reticulate,
+# reference README.md:27-41, 119-153); here the Python side is JAX on TPU
+# instead of TF over gRPC.
+
+.globals <- new.env(parent = emptyenv())
+
+#' Handle to the Python distributed_tpu module (lazy import).
+#' @export
+dtpu <- function() {
+  if (is.null(.globals$dtpu)) {
+    .globals$dtpu <- reticulate::import("distributed_tpu", delay_load = FALSE)
+  }
+  .globals$dtpu
+}
+
+.onLoad <- function(libname, pkgname) {
+  # Delay-load so library(distributedtpu) works before reticulate has
+  # selected a Python (the same pattern the R keras package uses).
+  .globals$dtpu <- reticulate::import("distributed_tpu", delay_load = TRUE)
+}
+
+#' Install the Python package into the active reticulate environment.
+#' The analogue of tensorflow::install_tensorflow() in the reference
+#' (README.md:34-41): run once per machine, then restart the session.
+#' @param path path to the distributed_tpu source tree (repo root)
+#' @export
+install_distributed_tpu <- function(path = NULL, envname = NULL) {
+  pkg <- if (is.null(path)) "distributed_tpu" else path
+  reticulate::py_install(pkg, envname = envname, pip = TRUE)
+}
+
+#' Framework version string (the reference's tf_version() check,
+#' README.md:40-41): confirms the R->Python binding resolves.
+#' @export
+dtpu_version <- function() {
+  dtpu()$`__version__`
+}
+
+#' @export
+`%>%` <- function(lhs, rhs) {
+  # Minimal forward pipe so the keras-style `model %>% fit(...)` UX works
+  # without a magrittr dependency; uses magrittr's if installed.
+  if (requireNamespace("magrittr", quietly = TRUE)) {
+    return(eval(call("%>%", substitute(lhs), substitute(rhs)),
+                envir = list("%>%" = magrittr::`%>%`),
+                enclos = parent.frame()))
+  }
+  rhs_call <- substitute(rhs)
+  if (is.call(rhs_call)) {
+    as_list <- as.list(rhs_call)
+    new_call <- as.call(c(as_list[[1]], substitute(lhs), as_list[-1]))
+    eval(new_call, envir = parent.frame())
+  } else {
+    (rhs)(lhs)
+  }
+}
