@@ -39,6 +39,22 @@ def free_port() -> int:
     return port
 
 
+def free_ports(n: int) -> List[int]:
+    """n distinct free ports, all held (bound) simultaneously before release
+    so none is a duplicate and all were genuinely free at the same moment —
+    unlike probing one port and assuming the next n-1 consecutive ones."""
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def check_reachable(addr: str, timeout: float = 2.0) -> bool:
     """TCP reachability to host:port (the programmatic 'ping', README.md:251).
 

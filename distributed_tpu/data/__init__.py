@@ -1,9 +1,20 @@
-from .datasets import load, load_cifar10, load_fashion_mnist, load_mnist, synthetic_images
+from .datasets import (
+    load,
+    load_cifar10,
+    load_fashion_mnist,
+    load_imagenet,
+    load_mnist,
+    synthetic_images,
+)
+from .pipeline import Pipeline, native_available
 
 __all__ = [
+    "Pipeline",
+    "native_available",
     "load",
     "load_mnist",
     "load_fashion_mnist",
     "load_cifar10",
+    "load_imagenet",
     "synthetic_images",
 ]

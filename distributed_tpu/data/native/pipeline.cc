@@ -1,0 +1,226 @@
+// Native input pipeline: threaded shuffle + gather + normalize + prefetch.
+//
+// Role in the framework: the TPU-native analogue of the native machinery the
+// reference system leans on out-of-repo (its input feeding and collective
+// path run in TF's C++ core; see SURVEY.md §2b). Host-side batch
+// preparation — permuting indices, gathering rows, uint8->float32 /255
+// normalization — is the part of the hot loop that is NOT XLA's job, and in
+// Python it stalls the accelerator between steps at ImageNet scale. Here it
+// runs in C++ worker threads that keep a bounded queue of ready batches
+// ahead of the consumer, so the host overlaps batch prep with device
+// execution.
+//
+// Exposed as a plain C ABI (no pybind11 in this image) and driven from
+// Python via ctypes; see ../pipeline.py, which also carries a pure-Python
+// fallback with the same semantics.
+//
+// Determinism: batch b of pass p depends only on (seed, p, b) — a
+// splitmix64-seeded Fisher-Yates permutation per pass — so two pipelines
+// constructed with the same arguments emit identical streams regardless of
+// thread count or timing.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64: tiny, seedable, high-quality enough for shuffling.
+struct SplitMix64 {
+  uint64_t s;
+  explicit SplitMix64(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // Unbiased bounded draw (rejection sampling).
+  uint64_t below(uint64_t bound) {
+    uint64_t threshold = (~bound + 1) % bound;  // 2^64 mod bound
+    for (;;) {
+      uint64_t r = next();
+      if (r >= threshold) return r % bound;
+    }
+  }
+};
+
+struct Slot {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  int64_t step = -1;  // which global step this slot holds; -1 = empty
+  bool filled = false;
+};
+
+}  // namespace
+
+struct DtpuPipeline {
+  const uint8_t* x;
+  const int32_t* y;
+  int64_t n, row, batch, steps_per_pass;
+  bool shuffle;
+  uint64_t seed;
+  float scale;
+  int depth;
+
+  std::mutex mu;
+  std::condition_variable cv_produce, cv_consume;
+  std::vector<Slot> slots;
+  std::atomic<int64_t> next_step{0};  // claimed by producers
+  std::atomic<int64_t> consumed{0};   // next step the consumer will take
+  bool stop = false;
+
+  // Lazily-built per-pass permutations (guarded by perm_mu). Only passes
+  // that can still be in a producer's fill window are retained; older ones
+  // are pruned so memory stays bounded over arbitrarily long runs (each
+  // pass's permutation is n * 8 bytes — ~10MB at ImageNet scale).
+  // shared_ptr keeps a pruned-but-in-use permutation alive for its reader.
+  std::mutex perm_mu;
+  std::map<int64_t, std::shared_ptr<std::vector<int64_t>>> perms;
+
+  std::vector<std::thread> workers;
+
+  std::shared_ptr<std::vector<int64_t>> perm_for(int64_t pass) {
+    std::lock_guard<std::mutex> lock(perm_mu);
+    auto it = perms.find(pass);
+    if (it == perms.end()) {
+      auto order = std::make_shared<std::vector<int64_t>>(n);
+      for (int64_t i = 0; i < n; ++i) (*order)[i] = i;
+      if (shuffle) {
+        // Seed mixes (seed, pass) so each pass reshuffles deterministically.
+        SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)pass + 1);
+        for (int64_t i = n - 1; i > 0; --i) {
+          int64_t j = (int64_t)rng.below((uint64_t)i + 1);
+          std::swap((*order)[i], (*order)[j]);
+        }
+      }
+      it = perms.emplace(pass, std::move(order)).first;
+    }
+    std::shared_ptr<std::vector<int64_t>> result = it->second;
+    // Any step still fillable is >= consumed, so passes below
+    // consumed / steps_per_pass can no longer be requested.
+    const int64_t min_pass = consumed.load() / steps_per_pass;
+    perms.erase(perms.begin(), perms.lower_bound(min_pass));
+    return result;
+  }
+
+  void fill(Slot& slot, int64_t step) {
+    int64_t pass = step / steps_per_pass;
+    int64_t within = step % steps_per_pass;
+    // Hold the shared_ptr for the whole fill: pruning may drop the map entry.
+    std::shared_ptr<std::vector<int64_t>> order_sp = perm_for(pass);
+    const std::vector<int64_t>& order = *order_sp;
+    const int64_t start = within * batch;
+    slot.x.resize((size_t)(batch * row));
+    slot.y.resize((size_t)batch);
+    for (int64_t b = 0; b < batch; ++b) {
+      const int64_t src = order[start + b];
+      const uint8_t* in = x + src * row;
+      float* out = slot.x.data() + b * row;
+      for (int64_t e = 0; e < row; ++e) out[e] = (float)in[e] * scale;
+      slot.y[(size_t)b] = y ? y[src] : 0;
+    }
+    slot.step = step;
+  }
+
+  void worker() {
+    for (;;) {
+      const int64_t step = next_step.fetch_add(1);
+      const int idx = (int)(step % depth);
+      Slot& slot = slots[(size_t)idx];
+      // Wait until the consumer has drained the previous occupant of this
+      // ring slot (step - depth), then fill and publish.
+      {
+        std::unique_lock<std::mutex> lock(mu);
+        cv_produce.wait(lock, [&] { return stop || consumed + depth > step; });
+        if (stop) return;
+      }
+      fill(slot, step);
+      {
+        std::lock_guard<std::mutex> lock(mu);
+        slot.filled = true;
+      }
+      cv_consume.notify_all();
+    }
+  }
+};
+
+extern "C" {
+
+DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
+                                   int64_t n, int64_t row_elems,
+                                   int64_t batch, int shuffle, uint64_t seed,
+                                   int depth, int threads, float scale) {
+  if (n <= 0 || batch <= 0 || batch > n || row_elems <= 0) return nullptr;
+  auto* p = new DtpuPipeline();
+  p->x = x;
+  p->y = y;
+  p->n = n;
+  p->row = row_elems;
+  p->batch = batch;
+  p->steps_per_pass = n / batch;
+  p->shuffle = shuffle != 0;
+  p->seed = seed;
+  p->scale = scale;
+  p->depth = depth < 1 ? 1 : depth;
+  p->slots.resize((size_t)p->depth);
+  int nthreads = threads < 1 ? 1 : threads;
+  if (nthreads > p->depth) nthreads = p->depth;
+  for (int i = 0; i < nthreads; ++i) {
+    p->workers.emplace_back([p] { p->worker(); });
+  }
+  return p;
+}
+
+// Copies the next batch (in deterministic step order) into caller buffers of
+// shape [batch, row_elems] float32 and [batch] int32. Returns the 0-based
+// step index, or -1 if the pipeline is stopped.
+int64_t dtpu_pipeline_next(DtpuPipeline* p, float* x_out, int32_t* y_out) {
+  Slot* slot;
+  int64_t step;
+  {
+    std::unique_lock<std::mutex> lock(p->mu);
+    step = p->consumed;
+    slot = &p->slots[(size_t)(step % p->depth)];
+    p->cv_consume.wait(lock, [&] {
+      return p->stop || (slot->filled && slot->step == step);
+    });
+    if (p->stop) return -1;
+  }
+  std::memcpy(x_out, slot->x.data(), sizeof(float) * (size_t)(p->batch * p->row));
+  if (y_out) {
+    std::memcpy(y_out, slot->y.data(), sizeof(int32_t) * (size_t)p->batch);
+  }
+  {
+    std::lock_guard<std::mutex> lock(p->mu);
+    slot->filled = false;
+    slot->step = -1;
+    p->consumed = step + 1;
+  }
+  p->cv_produce.notify_all();
+  return step;
+}
+
+int64_t dtpu_pipeline_steps_per_pass(DtpuPipeline* p) {
+  return p->steps_per_pass;
+}
+
+void dtpu_pipeline_destroy(DtpuPipeline* p) {
+  if (!p) return;
+  {
+    std::lock_guard<std::mutex> lock(p->mu);
+    p->stop = true;
+  }
+  p->cv_produce.notify_all();
+  p->cv_consume.notify_all();
+  for (std::thread& t : p->workers) t.join();
+  delete p;
+}
+
+}  // extern "C"

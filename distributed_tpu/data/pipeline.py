@@ -1,0 +1,232 @@
+"""Input pipeline: prefetched shuffle/gather/normalize batches.
+
+Two implementations behind one API:
+
+- **Native (C++)**: ``native/pipeline.cc`` compiled to a shared library and
+  driven via ctypes. Worker threads keep a bounded ring of ready float32
+  batches ahead of the consumer, overlapping host batch prep with device
+  execution — the host-side analogue of the native machinery the reference
+  gets from TF's C++ core (SURVEY.md §2b), which is what keeps a TPU fed at
+  ImageNet scale.
+- **Pure Python fallback**: same semantics (per-pass reshuffle, steps-per-
+  pass, /255 normalization), used when no C++ toolchain is available.
+
+Batch streams are deterministic in (seed, pass, step) *within* an
+implementation; the native and Python shuffles use different RNGs, so pick
+one implementation per experiment when bit-reproducibility matters.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import logging as dlog
+
+_NATIVE_DIR = Path(__file__).parent / "native"
+_LIB_NAME = "libdtpu_pipeline.so"
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the native pipeline library; None if
+    unavailable. Gated off entirely by DTPU_NO_NATIVE=1."""
+    global _lib, _lib_tried
+    with _lib_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("DTPU_NO_NATIVE") == "1":
+            return None
+        so = _NATIVE_DIR / _LIB_NAME
+        src = _NATIVE_DIR / "pipeline.cc"
+        try:
+            if not so.exists() or (
+                src.exists() and src.stat().st_mtime > so.stat().st_mtime
+            ):
+                subprocess.run(
+                    ["make", "-C", str(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(str(so))
+        except (OSError, subprocess.SubprocessError) as e:
+            dlog.warning(f"native pipeline unavailable ({e}); using Python")
+            return None
+        lib.dtpu_pipeline_create.restype = ctypes.c_void_p
+        lib.dtpu_pipeline_create.argtypes = [
+            ctypes.c_void_p,  # x
+            ctypes.c_void_p,  # y
+            ctypes.c_int64,   # n
+            ctypes.c_int64,   # row_elems
+            ctypes.c_int64,   # batch
+            ctypes.c_int,     # shuffle
+            ctypes.c_uint64,  # seed
+            ctypes.c_int,     # depth
+            ctypes.c_int,     # threads
+            ctypes.c_float,   # scale
+        ]
+        lib.dtpu_pipeline_next.restype = ctypes.c_int64
+        lib.dtpu_pipeline_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.dtpu_pipeline_steps_per_pass.restype = ctypes.c_int64
+        lib.dtpu_pipeline_steps_per_pass.argtypes = [ctypes.c_void_p]
+        lib.dtpu_pipeline_destroy.restype = None
+        lib.dtpu_pipeline_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+class Pipeline:
+    """Iterator of ``(x_float32, y_int32)`` batches with background prefetch.
+
+    Args:
+      x: uint8 array (N, ...), e.g. raw image bytes.
+      y: int labels (N,) or None.
+      batch_size: rows per emitted batch.
+      shuffle: reshuffle every pass (epoch) deterministically from ``seed``.
+      scale: multiplier applied during uint8->float32 (default 1/255, the
+        reference's normalization, /root/reference/README.md:56).
+      prefetch: ring depth — how many batches may be ready ahead.
+      num_threads: native producer threads.
+      use_native: force (True/False) or auto (None).
+
+    The stream is infinite (passes repeat, reshuffled); ``steps_per_pass``
+    tells one epoch's length, matching ``fit(steps_per_epoch=...)``.
+    """
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        y: Optional[np.ndarray],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        scale: float = 1.0 / 255.0,
+        prefetch: int = 4,
+        num_threads: int = 2,
+        use_native: Optional[bool] = None,
+    ):
+        x = np.ascontiguousarray(x)
+        if x.dtype != np.uint8:
+            raise TypeError(f"Pipeline feeds raw uint8 data, got {x.dtype}")
+        if batch_size <= 0 or batch_size > x.shape[0]:
+            raise ValueError(
+                f"batch_size {batch_size} invalid for {x.shape[0]} rows"
+            )
+        self._x = x
+        self._y = (
+            None if y is None else np.ascontiguousarray(y, dtype=np.int32)
+        )
+        if self._y is not None and len(self._y) != len(x):
+            raise ValueError("x and y lengths differ")
+        self.batch_size = int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.scale = float(scale)
+        self.prefetch = max(1, int(prefetch))
+        self.num_threads = max(1, int(num_threads))
+        self.steps_per_pass = x.shape[0] // self.batch_size
+        self.batch_shape = (self.batch_size,) + x.shape[1:]
+        self._row = int(np.prod(x.shape[1:], dtype=np.int64))
+
+        lib = _load_native() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("Native pipeline requested but unavailable")
+        self._lib = lib
+        self._handle = None
+        self._py_step = 0
+        self.steps_emitted = 0  # lets fit() fast-forward on resume
+        if lib is not None:
+            self._handle = lib.dtpu_pipeline_create(
+                self._x.ctypes.data_as(ctypes.c_void_p),
+                None if self._y is None
+                else self._y.ctypes.data_as(ctypes.c_void_p),
+                self._x.shape[0],
+                self._row,
+                self.batch_size,
+                1 if self.shuffle else 0,
+                self.seed,
+                self.prefetch,
+                self.num_threads,
+                self.scale,
+            )
+            if not self._handle:
+                raise RuntimeError("dtpu_pipeline_create failed")
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        xb = np.empty(self.batch_shape, np.float32)
+        yb = np.empty((self.batch_size,), np.int32)
+        if self._handle is not None:
+            step = self._lib.dtpu_pipeline_next(
+                self._handle,
+                xb.ctypes.data_as(ctypes.c_void_p),
+                yb.ctypes.data_as(ctypes.c_void_p),
+            )
+            if step < 0:
+                raise StopIteration
+            self.steps_emitted += 1
+            return xb, yb
+        # Python fallback: identical pass/step semantics, numpy RNG shuffle.
+        step = self._py_step
+        self._py_step += 1
+        pass_idx, within = divmod(step, self.steps_per_pass)
+        cached = getattr(self, "_perm_cache", None)
+        if cached is not None and cached[0] == pass_idx:
+            order = cached[1]
+        else:
+            rng = np.random.default_rng((self.seed, pass_idx))
+            order = (
+                rng.permutation(self._x.shape[0])
+                if self.shuffle
+                else np.arange(self._x.shape[0])
+            )
+            self._perm_cache = (pass_idx, order)
+        idx = order[within * self.batch_size : (within + 1) * self.batch_size]
+        xb[:] = self._x[idx].astype(np.float32) * self.scale
+        if self._y is not None:
+            yb[:] = self._y[idx]
+        else:
+            yb[:] = 0
+        self.steps_emitted += 1
+        return xb, yb
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.dtpu_pipeline_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -21,9 +21,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import shlex
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
@@ -99,8 +101,11 @@ class LocalLauncher:
         workdir: Optional[str] = None,
         base_port: Optional[int] = None,
     ) -> List[WorkerResult]:
-        port = base_port or net.free_port()
-        workers = [f"127.0.0.1:{port + i}" for i in range(num_workers)]
+        if base_port is not None:
+            ports = [base_port + i for i in range(num_workers)]
+        else:
+            ports = net.free_ports(num_workers)
+        workers = [f"127.0.0.1:{p}" for p in ports]
         tmp = Path(tempfile.mkdtemp(prefix="dtpu_launch_"))
         procs = []
         for i in range(num_workers):
@@ -196,6 +201,7 @@ class SSHLauncher:
         argv: Sequence[str],
         *,
         timeout: float = 3600.0,
+        grace: float = 10.0,
         env_extra: Optional[Dict[str, str]] = None,
     ) -> List[WorkerResult]:
         workers = [f"{h}:{self.port}" for h in self.hosts]
@@ -210,10 +216,13 @@ class SSHLauncher:
                 RESULT_STDOUT_ENV: "1",
                 **(env_extra or {}),
             }
+            # shlex.quote everything: env values hold JSON and argv may hold
+            # paths with spaces; unquoted, the remote shell would word-split
+            # and expand $/backtick metacharacters.
             export_str = " ".join(
-                f"{k}={json.dumps(v)}" for k, v in exports.items()
+                f"{k}={shlex.quote(v)}" for k, v in exports.items()
             )
-            remote = f"{export_str} {' '.join(argv)}"
+            remote = f"{export_str} {' '.join(shlex.quote(a) for a in argv)}"
             procs.append(
                 subprocess.Popen(
                     [self.ssh_cmd, host, remote],
@@ -222,13 +231,54 @@ class SSHLauncher:
                     text=True,
                 )
             )
-        results = []
-        for i, proc in enumerate(procs):
+        # Drain all stdout pipes concurrently: one log-heavy worker must not
+        # fill its pipe and stall the gang at a collective while we block on
+        # a different worker's communicate() (the "never a hang" contract).
+        outs: List[Optional[str]] = [None] * len(procs)
+
+        def _drain(i, proc):
             try:
-                out, _ = proc.communicate(timeout=timeout)
+                outs[i], _ = proc.communicate(timeout=timeout)
             except subprocess.TimeoutExpired:
                 proc.kill()
-                out, _ = proc.communicate()
+                outs[i], _ = proc.communicate()
+
+        drains = [
+            threading.Thread(target=_drain, args=(i, p), daemon=True)
+            for i, p in enumerate(procs)
+        ]
+        for t in drains:
+            t.start()
+        # Gang semantics (same as LocalLauncher): when one worker dies, its
+        # peers are blocked at their next collective waiting for it — kill
+        # them after `grace` instead of letting them burn the full timeout.
+        killed: set = set()
+        first_failure: Optional[float] = None
+        deadline = time.time() + timeout
+        while any(p.poll() is None for p in procs):
+            now = time.time()
+            if first_failure is None and any(
+                p.poll() not in (None, 0) for p in procs
+            ):
+                first_failure = now
+            if now > deadline or (
+                first_failure is not None and now > first_failure + grace
+            ):
+                kill_reason = (
+                    "timeout" if now > deadline
+                    else "killed after peer failure (gang semantics)"
+                )
+                for i, p in enumerate(procs):
+                    if p.poll() is None:
+                        killed.add(i)
+                        p.kill()
+                break
+            time.sleep(0.2)
+        for t in drains:
+            t.join()
+        results = []
+        for i, proc in enumerate(procs):
+            out = outs[i]
             value = None
             for line in (out or "").splitlines():
                 if line.startswith(self.MARK):
@@ -236,12 +286,18 @@ class SSHLauncher:
                         value = json.loads(line[len(self.MARK):])
                     except json.JSONDecodeError:
                         pass
+            if proc.returncode == 0:
+                err = None
+            elif i in killed:
+                err = kill_reason
+            else:
+                err = f"exit code {proc.returncode}"
             results.append(
                 WorkerResult(
                     index=i,
                     ok=proc.returncode == 0,
                     value=value,
-                    error=None if proc.returncode == 0 else f"exit code {proc.returncode}",
+                    error=err,
                     exit_code=proc.returncode,
                     log_tail="" if proc.returncode == 0 else (out or "")[-4096:],
                 )

@@ -1,0 +1,169 @@
+"""Native C++ input pipeline + Python fallback + fit() iterator mode.
+
+The native library is the framework's host-side native component (SURVEY.md
+§2b: the reference's hot path runs in TF's C++ core; here host batch prep
+runs in C++ worker threads). g++ is present in CI, so the native path is
+exercised for real, and the fallback is forced via use_native=False.
+"""
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.data import Pipeline, native_available
+
+
+def _dataset(n=64, shape=(8, 8, 1), classes=10, seed=0):
+    return dtpu.data.synthetic_images(n, shape, classes, seed)
+
+
+NATIVE_PARAMS = [
+    pytest.param(True, marks=pytest.mark.skipif(
+        not native_available(), reason="no C++ toolchain")),
+    False,
+]
+
+
+@pytest.mark.parametrize("use_native", NATIVE_PARAMS)
+class TestPipeline:
+    def test_shapes_dtypes_normalization(self, use_native):
+        x, y = _dataset()
+        p = Pipeline(x, y, 16, shuffle=False, use_native=use_native)
+        xb, yb = next(p)
+        assert xb.shape == (16, 8, 8, 1) and xb.dtype == np.float32
+        assert yb.shape == (16,) and yb.dtype == np.int32
+        # shuffle=False: first batch is rows 0..15 normalized
+        np.testing.assert_allclose(xb, x[:16].astype(np.float32) / 255.0)
+        np.testing.assert_array_equal(yb, y[:16])
+        p.close()
+
+    def test_each_pass_covers_all_rows(self, use_native):
+        x, y = _dataset(n=48)
+        y = np.arange(48, dtype=np.int32)  # labels identify rows
+        p = Pipeline(x, y, 12, shuffle=True, seed=3, use_native=use_native)
+        assert p.steps_per_pass == 4
+        for _pass in range(2):
+            seen = []
+            for _ in range(4):
+                _, yb = next(p)
+                seen.extend(yb.tolist())
+            assert sorted(seen) == list(range(48))
+        p.close()
+
+    def test_deterministic_across_instances(self, use_native):
+        x, y = _dataset(n=40)
+        a = Pipeline(x, y, 8, seed=7, use_native=use_native)
+        b = Pipeline(x, y, 8, seed=7, use_native=use_native)
+        for _ in range(10):
+            xa, ya = next(a)
+            xb, yb = next(b)
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+        a.close()
+        b.close()
+
+    def test_reshuffles_between_passes(self, use_native):
+        x, _ = _dataset(n=64)
+        y = np.arange(64, dtype=np.int32)
+        p = Pipeline(x, y, 64, shuffle=True, seed=1, use_native=use_native)
+        _, y1 = next(p)
+        _, y2 = next(p)
+        assert not np.array_equal(y1, y2)  # different pass permutations
+        p.close()
+
+    def test_rejects_bad_inputs(self, use_native):
+        x, y = _dataset()
+        with pytest.raises(TypeError):
+            Pipeline(x.astype(np.float32), y, 8, use_native=use_native)
+        with pytest.raises(ValueError):
+            Pipeline(x, y, 0, use_native=use_native)
+        with pytest.raises(ValueError):
+            Pipeline(x, y[:-1], 8, use_native=use_native)
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+class TestNativeSpecifics:
+    def test_prefetch_deeper_than_one_pass(self):
+        # depth > steps_per_pass exercises the ring wraparound + pass
+        # boundary under concurrency.
+        x, y = _dataset(n=32)
+        p = Pipeline(x, y, 16, seed=5, prefetch=8, num_threads=4,
+                     use_native=True)
+        ref = Pipeline(x, y, 16, seed=5, prefetch=1, num_threads=1,
+                       use_native=True)
+        for _ in range(12):
+            xa, ya = next(p)
+            xb, yb = next(ref)
+            np.testing.assert_array_equal(xa, xb)  # order is thread-invariant
+            np.testing.assert_array_equal(ya, yb)
+        p.close()
+        ref.close()
+
+    def test_close_is_idempotent(self):
+        x, y = _dataset()
+        p = Pipeline(x, y, 8, use_native=True)
+        next(p)
+        p.close()
+        p.close()
+
+
+class TestFitFromPipeline:
+    def test_fit_trains_from_iterator(self):
+        x, y = _dataset(n=256, shape=(28, 28, 1))
+        with Pipeline(x, y, 64, seed=2) as p:
+            model = dtpu.Model(dtpu.models.mnist_cnn())
+            model.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=["accuracy"])
+            hist = model.fit(p, epochs=3, verbose=0)
+        assert len(hist.history["loss"]) == 3
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_resume_fast_forwards_pipeline(self, tmp_path):
+        # Crash-restart with a Pipeline source: the resumed run must advance
+        # the source past already-consumed batches and finish on the same
+        # params as an uninterrupted run.
+        from distributed_tpu.training.callbacks import ModelCheckpoint
+
+        x, y = _dataset(n=256, shape=(12, 12, 1))
+
+        def make_model():
+            m = dtpu.Model(dtpu.models.mnist_cnn())
+            m.compile(optimizer=dtpu.optim.SGD(0.05),
+                      loss="sparse_categorical_crossentropy")
+            m.build((12, 12, 1), seed=0)
+            return m
+
+        with Pipeline(x, y, 64, seed=8, use_native=False) as p1:
+            m1 = make_model()
+            m1.fit(p1, epochs=4, verbose=0)
+
+        with Pipeline(x, y, 64, seed=8, use_native=False) as p2:
+            m2 = make_model()
+            m2.fit(p2, epochs=2, verbose=0,
+                   callbacks=[ModelCheckpoint(tmp_path, save_freq="epoch")])
+        with Pipeline(x, y, 64, seed=8, use_native=False) as p3:  # relaunch
+            m3 = make_model()
+            m3.fit(p3, epochs=4, verbose=0,
+                   callbacks=[ModelCheckpoint(tmp_path, save_freq="epoch",
+                                              restore=True)])
+        assert m3.step == m1.step
+        import jax
+
+        for a, b in zip(jax.tree_util.tree_leaves(m1.params),
+                        jax.tree_util.tree_leaves(m3.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_plain_iterator_requires_steps(self):
+        model = dtpu.Model(dtpu.models.mnist_cnn())
+        model.compile(optimizer=dtpu.optim.SGD(0.1),
+                      loss="sparse_categorical_crossentropy")
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            model.fit(iter([]), epochs=1)
+
+    def test_non_iterator_without_y_rejected(self):
+        model = dtpu.Model(dtpu.models.mnist_cnn())
+        model.compile(optimizer=dtpu.optim.SGD(0.1),
+                      loss="sparse_categorical_crossentropy")
+        with pytest.raises(ValueError, match="batch iterator"):
+            model.fit(np.zeros((8, 28, 28, 1)))
