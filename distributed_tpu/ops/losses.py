@@ -105,9 +105,24 @@ def get_per_example(loss_fn):
     return _PER_EXAMPLE.get(loss_fn)
 
 
+def _register_pallas():
+    # Lazy: the Pallas kernels import jax.experimental.pallas, which is not
+    # needed unless the fused loss is requested.
+    from . import pallas_kernels as pk
+
+    _REGISTRY["pallas_sparse_categorical_crossentropy"] = (
+        pk.pallas_sparse_categorical_crossentropy
+    )
+    _PER_EXAMPLE[pk.pallas_sparse_categorical_crossentropy] = (
+        pk.per_example_pallas_xent
+    )
+
+
 def get(name_or_fn):
     if callable(name_or_fn):
         return name_or_fn
+    if name_or_fn == "pallas_sparse_categorical_crossentropy":
+        _register_pallas()
     try:
         return _REGISTRY[name_or_fn]
     except KeyError:
