@@ -156,7 +156,8 @@ extern "C" {
 DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
                                    int64_t n, int64_t row_elems,
                                    int64_t batch, int shuffle, uint64_t seed,
-                                   int depth, int threads, float scale) {
+                                   int depth, int threads, float scale,
+                                   int64_t start_step) {
   if (n <= 0 || batch <= 0 || batch > n || row_elems <= 0) return nullptr;
   auto* p = new DtpuPipeline();
   p->x = x;
@@ -169,6 +170,11 @@ DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
   p->seed = seed;
   p->scale = scale;
   p->depth = depth < 1 ? 1 : depth;
+  // Resume support: start emitting at an arbitrary global step (O(1) seek —
+  // step order depends only on (seed, pass, within), not on history).
+  if (start_step < 0) start_step = 0;
+  p->next_step.store(start_step);
+  p->consumed.store(start_step);
   p->slots.resize((size_t)p->depth);
   int nthreads = threads < 1 ? 1 : threads;
   if (nthreads > p->depth) nthreads = p->depth;

@@ -53,12 +53,29 @@ def _load_native() -> Optional[ctypes.CDLL]:
             if not so.exists() or (
                 src.exists() and src.stat().st_mtime > so.stat().st_mtime
             ):
-                subprocess.run(
-                    ["make", "-C", str(_NATIVE_DIR)],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
+                # Inter-process file lock: gang workers on one host may all
+                # hit the missing-.so case at once, and make writes the .so
+                # in place — an unsynchronized peer could dlopen a half-
+                # written file and silently fall back to the Python RNG,
+                # diverging its data order from the rest of the gang.
+                import fcntl
+
+                lock_path = _NATIVE_DIR / ".build.lock"
+                with open(lock_path, "w") as lock_f:
+                    fcntl.flock(lock_f, fcntl.LOCK_EX)
+                    try:
+                        if not so.exists() or (
+                            src.exists()
+                            and src.stat().st_mtime > so.stat().st_mtime
+                        ):
+                            subprocess.run(
+                                ["make", "-C", str(_NATIVE_DIR)],
+                                check=True,
+                                capture_output=True,
+                                timeout=120,
+                            )
+                    finally:
+                        fcntl.flock(lock_f, fcntl.LOCK_UN)
             lib = ctypes.CDLL(str(so))
         except (OSError, subprocess.SubprocessError) as e:
             dlog.warning(f"native pipeline unavailable ({e}); using Python")
@@ -75,6 +92,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_int,     # depth
             ctypes.c_int,     # threads
             ctypes.c_float,   # scale
+            ctypes.c_int64,   # start_step
         ]
         lib.dtpu_pipeline_next.restype = ctypes.c_int64
         lib.dtpu_pipeline_next.argtypes = [
@@ -152,23 +170,45 @@ class Pipeline:
         self._lib = lib
         self._handle = None
         self._py_step = 0
+        self._closed = False
         self.steps_emitted = 0  # lets fit() fast-forward on resume
         if lib is not None:
-            self._handle = lib.dtpu_pipeline_create(
-                self._x.ctypes.data_as(ctypes.c_void_p),
-                None if self._y is None
-                else self._y.ctypes.data_as(ctypes.c_void_p),
-                self._x.shape[0],
-                self._row,
-                self.batch_size,
-                1 if self.shuffle else 0,
-                self.seed,
-                self.prefetch,
-                self.num_threads,
-                self.scale,
-            )
-            if not self._handle:
-                raise RuntimeError("dtpu_pipeline_create failed")
+            self._handle = self._create_handle(0)
+
+    def _create_handle(self, start_step: int):
+        handle = self._lib.dtpu_pipeline_create(
+            self._x.ctypes.data_as(ctypes.c_void_p),
+            None if self._y is None
+            else self._y.ctypes.data_as(ctypes.c_void_p),
+            self._x.shape[0],
+            self._row,
+            self.batch_size,
+            1 if self.shuffle else 0,
+            self.seed,
+            self.prefetch,
+            self.num_threads,
+            self.scale,
+            start_step,
+        )
+        if not handle:
+            raise RuntimeError("dtpu_pipeline_create failed")
+        return handle
+
+    def seek(self, step: int):
+        """Jump to global step ``step`` in O(1): the stream position depends
+        only on (seed, pass, within), so resume never replays or re-prepares
+        skipped batches. Used by ``fit()`` on checkpoint-restart."""
+        if self._closed:
+            raise ValueError("Pipeline is closed")
+        step = int(step)
+        if step < 0:
+            raise ValueError(f"seek target must be >= 0, got {step}")
+        if self._handle is not None:
+            self._lib.dtpu_pipeline_destroy(self._handle)
+            self._handle = self._create_handle(step)
+        else:
+            self._py_step = step
+        self.steps_emitted = step
 
     @property
     def is_native(self) -> bool:
@@ -178,6 +218,8 @@ class Pipeline:
         return self
 
     def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        if self._closed:
+            raise ValueError("Pipeline is closed")
         xb = np.empty(self.batch_shape, np.float32)
         yb = np.empty((self.batch_size,), np.int32)
         if self._handle is not None:
@@ -215,6 +257,7 @@ class Pipeline:
         return xb, yb
 
     def close(self):
+        self._closed = True
         if self._handle is not None:
             self._lib.dtpu_pipeline_destroy(self._handle)
             self._handle = None

@@ -236,9 +236,12 @@ class SSHLauncher:
         # a different worker's communicate() (the "never a hang" contract).
         outs: List[Optional[str]] = [None] * len(procs)
 
+        # The monitor loop owns timeout enforcement (so a timeout kill is
+        # labeled "timeout", not misread as a peer failure); the drain
+        # communicate() deadline sits beyond it purely as a backstop.
         def _drain(i, proc):
             try:
-                outs[i], _ = proc.communicate(timeout=timeout)
+                outs[i], _ = proc.communicate(timeout=timeout + grace + 30.0)
             except subprocess.TimeoutExpired:
                 proc.kill()
                 outs[i], _ = proc.communicate()

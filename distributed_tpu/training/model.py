@@ -283,9 +283,12 @@ class Model:
                 # The array path fast-forwards via _index_stream(start_step);
                 # an iterator source must be advanced too or the resumed run
                 # retrains on already-consumed batches.
-                emitted = getattr(source, "steps_emitted", None)
-                if emitted is not None:
-                    for _ in range(max(0, self._resumed_step - emitted)):
+                if hasattr(source, "seek"):
+                    source.seek(self._resumed_step)  # O(1), no batch prep
+                elif getattr(source, "steps_emitted", None) is not None:
+                    for _ in range(
+                        max(0, self._resumed_step - source.steps_emitted)
+                    ):
                         next(source)
                 else:
                     dlog.warning(

@@ -71,6 +71,29 @@ class TestPipeline:
         assert not np.array_equal(y1, y2)  # different pass permutations
         p.close()
 
+    def test_seek_matches_sequential_consumption(self, use_native):
+        x, y = _dataset(n=48)
+        a = Pipeline(x, y, 12, seed=6, use_native=use_native)
+        for _ in range(7):  # consume into pass 1
+            next(a)
+        xa, ya = next(a)  # step 7
+        b = Pipeline(x, y, 12, seed=6, use_native=use_native)
+        b.seek(7)
+        xb, yb = next(b)
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        assert b.steps_emitted == 8
+        a.close()
+        b.close()
+
+    def test_next_after_close_raises(self, use_native):
+        x, y = _dataset()
+        p = Pipeline(x, y, 8, use_native=use_native)
+        next(p)
+        p.close()
+        with pytest.raises(ValueError, match="closed"):
+            next(p)
+
     def test_rejects_bad_inputs(self, use_native):
         x, y = _dataset()
         with pytest.raises(TypeError):
