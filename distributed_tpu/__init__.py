@@ -32,6 +32,7 @@ from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
     DataParallel,
+    DataTensorParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
@@ -47,6 +48,7 @@ __all__ = [
     "Strategy",
     "SingleDevice",
     "DataParallel",
+    "DataTensorParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",
     "make_mesh",

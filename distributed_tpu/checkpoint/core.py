@@ -230,16 +230,20 @@ class Checkpointer:
         tree, meta = load_npz(self._path(step))
         if not model.built:
             model.build(meta["input_shape"], seed=meta.get("seed", 0))
-        model.params = model.strategy.put_params(tree["params"])
+        hints = getattr(model, "_param_hints", None)
+        model.params = model.strategy.put_params(tree["params"], hints=hints)
         model.state = model.strategy.put_params(tree.get("state") or {})
         if model.compiled and tree.get("opt_state") is not None:
             # npz round-trips optax's NamedTuple state as plain tuples/dicts;
             # graft the saved leaves back onto a freshly-init'd structure.
-            template = model.tx.init(model.params)
+            # Placement via the strategy's (eager) init keeps TP shardings
+            # consistent with the already-placed params.
+            template = model.strategy.init_opt_state(model.tx, model.params)
             leaves = jax.tree_util.tree_leaves(tree["opt_state"])
             treedef = jax.tree_util.tree_structure(template)
-            model.opt_state = model.strategy.put_params(
-                jax.tree_util.tree_unflatten(treedef, leaves)
+            shardings = jax.tree_util.tree_map(lambda a: a.sharding, template)
+            model.opt_state = jax.device_put(
+                jax.tree_util.tree_unflatten(treedef, leaves), shardings
             )
         model.step = int(meta["step"])
         model._seed = int(meta.get("seed", model._seed))
@@ -332,14 +336,25 @@ class Checkpointer:
             treedef = jax.tree_util.tree_structure(template)
             return jax.tree_util.tree_unflatten(treedef, list(leaves))
 
-        model.params = model.strategy.put_params(graft(model.params, p_leaves))
+        model.params = model.strategy.put_params(
+            graft(model.params, p_leaves),
+            hints=getattr(model, "_param_hints", None),
+        )
         if ck_s:
             model.state = model.strategy.put_params(
                 graft(model.state, s_leaves)
             )
         if ck_o:
-            model.opt_state = model.strategy.put_params(
-                graft(opt_template, o_leaves)
+            # Same template-sharding placement as the single-host path, so a
+            # TP gang's optimizer state comes back sharded, not replicated.
+            placed_template = model.strategy.init_opt_state(
+                model.tx, model.params
+            )
+            shardings = jax.tree_util.tree_map(
+                lambda a: a.sharding, placed_template
+            )
+            model.opt_state = jax.device_put(
+                graft(opt_template, o_leaves), shardings
             )
         model.step = agreed
         model._seed = seed

@@ -1,5 +1,6 @@
 from .cnn import cifar_cnn, mnist_cnn
 from .resnet import resnet, resnet18, resnet34, resnet50
+from .transformer import transformer_block, transformer_lm
 
 __all__ = [
     "mnist_cnn",
@@ -8,4 +9,6 @@ __all__ = [
     "resnet18",
     "resnet34",
     "resnet50",
+    "transformer_lm",
+    "transformer_block",
 ]

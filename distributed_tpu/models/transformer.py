@@ -1,0 +1,72 @@
+"""Decoder-only Transformer language model.
+
+Not present in the reference (no attention of any kind, SURVEY.md §2c); this
+is the model family that exercises the framework's long-context/TP design:
+pre-LN blocks built from the same Residual/Sequential primitives as ResNet,
+MultiHeadAttention + MLP carrying Megatron tensor-parallel sharding hints
+(q/k/v + MLP-in column-sharded over the 'model' mesh axis, projections
+row-sharded), so ``DataTensorParallel`` distributes it with zero
+model-side changes. Pairs with the Pallas fused cross-entropy for the
+large-vocab LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn
+
+
+def transformer_block(
+    d_model: int, num_heads: int, d_ff: int, *, causal: bool = True, dtype=None
+) -> list:
+    """Pre-LN block as two Residuals: [LN -> MHA] + [LN -> MLP]."""
+    attn = nn.Residual(
+        nn.Sequential(
+            [
+                nn.LayerNorm(),
+                nn.MultiHeadAttention(num_heads, causal=causal, dtype=dtype),
+            ],
+            name="main",
+        )
+    )
+    mlp = nn.Residual(
+        nn.Sequential(
+            [
+                nn.LayerNorm(),
+                nn.Dense(d_ff, activation="gelu", shard="col", dtype=dtype),
+                nn.Dense(d_model, shard="row", dtype=dtype),
+            ],
+            name="main",
+        )
+    )
+    return [attn, mlp]
+
+
+def transformer_lm(
+    vocab_size: int,
+    *,
+    num_layers: int = 2,
+    d_model: int = 128,
+    num_heads: int = 4,
+    d_ff: Optional[int] = None,
+    max_len: int = 512,
+    causal: bool = True,
+    dtype=None,
+) -> nn.Sequential:
+    """Token-in, logits-out LM: (B, T) int32 -> (B, T, vocab).
+
+    Train with ``loss="sparse_categorical_crossentropy"`` (or the fused
+    ``"pallas_sparse_categorical_crossentropy"``) on next-token labels.
+    """
+    d_ff = d_ff or 4 * d_model
+    layers = [
+        nn.Embedding(vocab_size, d_model, dtype=dtype),
+        nn.PositionalEmbedding(max_len),
+    ]
+    for _ in range(num_layers):
+        layers += transformer_block(
+            d_model, num_heads, d_ff, causal=causal, dtype=dtype
+        )
+    layers += [nn.LayerNorm(), nn.Dense(vocab_size, dtype=dtype)]
+    return nn.Sequential(layers, name="transformer_lm")

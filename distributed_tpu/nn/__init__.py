@@ -1,3 +1,4 @@
+from .attention import MultiHeadAttention, PositionalEmbedding
 from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
     Activation,
@@ -29,4 +30,6 @@ __all__ = [
     "LayerNorm",
     "Dropout",
     "Embedding",
+    "MultiHeadAttention",
+    "PositionalEmbedding",
 ]

@@ -1,0 +1,130 @@
+"""Attention and positional embedding layers.
+
+The reference has no attention anywhere (SURVEY.md §2c: inputs are 28x28
+images); these layers exist so long-context/distributed training is shaped
+into the core design (mesh axes 'seq'/'model' in parallel.mesh.AXES) rather
+than bolted on. TPU notes:
+
+- Scores/softmax compute in float32 regardless of activation dtype; the
+  einsums lower to MXU matmuls.
+- QKV projections are stored as 2D (D, heads*head_dim) kernels so Megatron
+  TP is a plain PartitionSpec: q/k/v column-sharded over the 'model' axis
+  (splitting heads), output projection row-sharded — XLA inserts the
+  all-reduce after the row matmul.
+- The causal mask is built from static shapes (no dynamic control flow), so
+  the whole layer jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import initializers
+from .core import Layer, Shape
+
+
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention over (B, T, D) inputs."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        head_dim: Optional[int] = None,
+        *,
+        causal: bool = False,
+        use_bias: bool = True,
+        dtype=None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.num_heads = int(num_heads)
+        self.head_dim = head_dim
+        self.causal = bool(causal)
+        self.use_bias = use_bias
+        self.dtype = dtype
+
+    def init(self, key, input_shape: Shape):
+        d = input_shape[-1]
+        hd = self.head_dim or d // self.num_heads
+        if self.head_dim is None and d % self.num_heads:
+            raise ValueError(
+                f"d_model {d} not divisible by num_heads {self.num_heads}"
+            )
+        inner = self.num_heads * hd
+        keys = jax.random.split(key, 4)
+        init = initializers.get("glorot_uniform")
+        params = {
+            "wq": init(keys[0], (d, inner), jnp.float32),
+            "wk": init(keys[1], (d, inner), jnp.float32),
+            "wv": init(keys[2], (d, inner), jnp.float32),
+            "wo": init(keys[3], (inner, d), jnp.float32),
+        }
+        if self.use_bias:
+            params.update(
+                bq=jnp.zeros((inner,), jnp.float32),
+                bk=jnp.zeros((inner,), jnp.float32),
+                bv=jnp.zeros((inner,), jnp.float32),
+                bo=jnp.zeros((d,), jnp.float32),
+            )
+        return params, {}, tuple(input_shape)
+
+    def sharding_hints(self):
+        hints = {"wq": "col", "wk": "col", "wv": "col", "wo": "row"}
+        if self.use_bias:
+            hints.update(bq="col", bk="col", bv="col")
+        return hints
+
+    def _proj(self, params, x, w, b):
+        kernel = params[w]
+        if self.dtype is not None:
+            kernel = kernel.astype(self.dtype)
+        y = jnp.dot(x, kernel)
+        if self.use_bias:
+            y = y + params[b].astype(y.dtype)
+        return y
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        b, t, _ = x.shape
+        h = self.num_heads
+        hd = params["wq"].shape[1] // h  # robust if apply runs on a fresh instance
+        q = self._proj(params, x, "wq", "bq").reshape(b, t, h, hd)
+        k = self._proj(params, x, "wk", "bk").reshape(b, t, h, hd)
+        v = self._proj(params, x, "wv", "bv").reshape(b, t, h, hd)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))
+        if self.causal:
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(b, t, h * hd)
+        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        if self.use_bias:
+            out = out + params["bo"].astype(out.dtype)
+        return out, {}
+
+
+class PositionalEmbedding(Layer):
+    """Learned absolute positions, added to (B, T, D) activations."""
+
+    def __init__(self, max_len: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.max_len = int(max_len)
+
+    def init(self, key, input_shape: Shape):
+        t, d = input_shape
+        if t > self.max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds max_len {self.max_len}"
+            )
+        table = initializers.normal(0.02)(key, (self.max_len, d), jnp.float32)
+        return {"table": table}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        t = x.shape[1]
+        return x + params["table"][:t][None].astype(x.dtype), {}

@@ -60,6 +60,13 @@ class Layer:
         raise NotImplementedError
 
     # -- shared helpers -----------------------------------------------------
+    def sharding_hints(self) -> Dict[str, str]:
+        """Tensor-parallel roles for this layer's params: param name ->
+        'col' (shard output dim over the model axis) or 'row' (shard input
+        dim). Containers nest these to mirror the params tree; strategies
+        translate roles into PartitionSpecs. Empty = fully replicated."""
+        return {}
+
     def default_name(self) -> str:
         return _camel_to_snake(type(self).__name__)
 
@@ -135,6 +142,14 @@ class Sequential(Layer):
             if s:
                 state[layer.name] = s
         return params, state, shape
+
+    def sharding_hints(self):
+        hints = {}
+        for layer in self.layers:
+            h = layer.sharding_hints()
+            if h:
+                hints[layer.name] = h
+        return hints
 
     def apply(self, params, state, x, *, train=False, rng=None):
         new_state: State = {}
@@ -219,6 +234,17 @@ class Residual(Layer):
         if ss:
             state["shortcut"] = ss
         return params, state, out_main
+
+    def sharding_hints(self):
+        hints = {}
+        h = self.main.sharding_hints()
+        if h:
+            hints["main"] = h
+        if self.shortcut is not None:
+            h = self.shortcut.sharding_hints()
+            if h:
+                hints["shortcut"] = h
+        return hints
 
     def apply(self, params, state, x, *, train=False, rng=None):
         rngs = (

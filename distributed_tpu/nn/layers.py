@@ -104,6 +104,7 @@ class Dense(Layer):
         use_bias: bool = True,
         kernel_initializer="glorot_uniform",
         dtype=None,
+        shard: Optional[str] = None,
         name: Optional[str] = None,
     ):
         super().__init__(name)
@@ -112,6 +113,21 @@ class Dense(Layer):
         self.use_bias = use_bias
         self.kernel_initializer = kernel_initializer
         self.dtype = dtype
+        if shard not in (None, "col", "row"):
+            raise ValueError(f"shard must be None/'col'/'row', got {shard!r}")
+        self.shard = shard
+
+    def sharding_hints(self):
+        # Megatron-style TP: 'col' splits the output features over the model
+        # axis (bias splits with them); 'row' splits the input features (the
+        # partial products are summed by an XLA-inserted all-reduce, so the
+        # bias stays replicated).
+        if self.shard is None:
+            return {}
+        hints = {"kernel": self.shard}
+        if self.use_bias and self.shard == "col":
+            hints["bias"] = "col"
+        return hints
 
     def init(self, key, input_shape: Shape):
         din = input_shape[-1]

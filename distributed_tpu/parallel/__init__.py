@@ -1,6 +1,7 @@
 from .mesh import AXES, batch_sharding, make_mesh, replicated
 from .strategy import (
     DataParallel,
+    DataTensorParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
@@ -15,6 +16,7 @@ __all__ = [
     "Strategy",
     "SingleDevice",
     "DataParallel",
+    "DataTensorParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",
 ]

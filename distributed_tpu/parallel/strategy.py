@@ -65,8 +65,15 @@ class Strategy:
     def batch_sharding(self):
         return None
 
-    def put_params(self, params):
+    def put_params(self, params, hints=None):
+        """Place a params-like pytree. ``hints`` is the module's nested
+        tensor-parallel role tree (nn.Layer.sharding_hints); strategies
+        without a model axis ignore it."""
         return params
+
+    def init_opt_state(self, tx, params):
+        """Optimizer state placed consistently with the params."""
+        return self.put_params(tx.init(params))
 
     def put_batch(self, batch):
         """Place a host-global numpy batch onto devices."""
@@ -86,7 +93,7 @@ class SingleDevice(Strategy):
     def put_batch(self, batch):
         return jax.device_put(batch, self.device)
 
-    def put_params(self, params):
+    def put_params(self, params, hints=None):
         return jax.device_put(params, self.device)
 
 
@@ -122,7 +129,7 @@ class DataParallel(Strategy):
     def batch_sharding(self):
         return NamedSharding(self.mesh, PartitionSpec(self.axis))
 
-    def put_params(self, params):
+    def put_params(self, params, hints=None):
         rep = NamedSharding(self.mesh, PartitionSpec())
         return jax.device_put(params, rep)
 
@@ -156,6 +163,88 @@ class DataParallel(Strategy):
                 f"Global batch {global_batch} not divisible by {n} replicas"
             )
         return global_batch // n
+
+
+class DataTensorParallel(DataParallel):
+    """2-axis parallelism: batch sharded over 'data', weight matrices of
+    hinted layers (Dense(shard=...), MultiHeadAttention) Megatron-sharded
+    over 'model'.
+
+    Beyond the reference (whose only strategy is mirrored DP, SURVEY.md
+    §2c); built on the same mesh so DP remains the degenerate case — the
+    design requirement that TP "compose later" made concrete. The sharded
+    matmuls and their all-reduces are emitted by XLA from the parameter
+    NamedShardings; there is no hand-written collective code.
+    """
+
+    def __init__(
+        self,
+        devices=None,
+        *,
+        mesh: Optional[Mesh] = None,
+        model_parallel: int = 2,
+        axis: str = "data",
+        model_axis: str = "model",
+    ):
+        if mesh is None:
+            ndev = len(devices or jax.devices())
+            if ndev % model_parallel:
+                raise ValueError(
+                    f"{ndev} devices not divisible by model_parallel="
+                    f"{model_parallel}"
+                )
+            mesh = make_mesh(
+                {axis: ndev // model_parallel, model_axis: model_parallel},
+                devices=devices,
+            )
+        super().__init__(mesh=mesh, axis=axis)
+        if model_axis not in mesh.axis_names:
+            raise ValueError(
+                f"Mesh {mesh.axis_names} has no axis {model_axis!r}"
+            )
+        self.model_axis = model_axis
+
+    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+        m = self.model_axis
+        if role == "col":  # shard output/features dim (last)
+            return PartitionSpec(*([None] * (ndim - 1) + [m]))
+        if role == "row":  # shard input dim (first)
+            return PartitionSpec(*([m] + [None] * (ndim - 1)))
+        return PartitionSpec()
+
+    def params_sharding(self, params, hints=None):
+        def walk(p, h):
+            if isinstance(p, dict):
+                return {
+                    k: walk(v, h.get(k, {}) if isinstance(h, dict) else {})
+                    for k, v in p.items()
+                }
+            role = h if isinstance(h, str) else None
+            return NamedSharding(self.mesh, self._role_spec(role, p.ndim))
+
+        return walk(params, hints or {})
+
+    def put_params(self, params, hints=None):
+        if hints:
+            return jax.device_put(params, self.params_sharding(params, hints))
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return jax.device_put(params, rep)
+
+    def init_opt_state(self, tx, params):
+        # Eager init: zeros_like/stat tensors inherit each parameter's
+        # NamedSharding directly (a jitted init would lose it — the outputs
+        # have no value dependence on the inputs, so GSPMD unpins them).
+        # Leaves created from scratch (step counters etc.) get replicated.
+        opt = tx.init(params)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def place(a):
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+                return a
+            return jax.device_put(a, rep)
+
+        return jax.tree_util.tree_map(place, opt)
 
 
 # Alias keeping the reference's class name greppable for migrating users.

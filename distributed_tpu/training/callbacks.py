@@ -159,7 +159,10 @@ class EarlyStopping(Callback):
 
     def on_train_end(self, model, history):
         if self.restore_best and self._best_params is not None:
-            model.params = model.strategy.put_params(self._best_params)
+            model.params = model.strategy.put_params(
+                self._best_params,
+                hints=getattr(model, "_param_hints", None),
+            )
             model.state = model.strategy.put_params(self._best_state)
 
 

@@ -96,10 +96,13 @@ class Model:
         self._seed = seed
         key = jax.random.PRNGKey(seed)
         params, state, _ = self.module.init(key, self.input_shape)
-        self.params = self.strategy.put_params(params)
+        # Tensor-parallel role tree (empty for unhinted models); strategies
+        # without a model axis ignore it.
+        self._param_hints = self.module.sharding_hints()
+        self.params = self.strategy.put_params(params, hints=self._param_hints)
         self.state = self.strategy.put_params(state)
         if self.compiled:
-            self.opt_state = self.strategy.put_params(self.tx.init(self.params))
+            self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         self.built = True
         return self
 
@@ -116,7 +119,7 @@ class Model:
         self.compiled = True
         self._train_step = self._eval_step = None
         if self.built:
-            self.opt_state = self.strategy.put_params(self.tx.init(self.params))
+            self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self
 
     @property
