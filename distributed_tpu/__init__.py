@@ -32,6 +32,7 @@ from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
     DataParallel,
+    DataSeqParallel,
     DataTensorParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
@@ -48,6 +49,7 @@ __all__ = [
     "Strategy",
     "SingleDevice",
     "DataParallel",
+    "DataSeqParallel",
     "DataTensorParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",

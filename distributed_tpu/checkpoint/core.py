@@ -32,6 +32,22 @@ import numpy as np
 SEP = "/"
 
 
+def _to_host(val) -> np.ndarray:
+    """Full global value on the host. Tensor-parallel leaves whose shards
+    live on other processes can't be device_get directly; they are gathered
+    collectively — which is why flatten_tree must run on EVERY process of a
+    gang before any chief-only gate."""
+    if (
+        isinstance(val, jax.Array)
+        and not val.is_fully_addressable
+        and not val.is_fully_replicated
+    ):
+        from jax.experimental import multihost_utils
+
+        val = multihost_utils.process_allgather(val, tiled=True)
+    return np.asarray(jax.device_get(val))
+
+
 def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
     flat = {}
     if isinstance(tree, dict):
@@ -43,7 +59,7 @@ def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
     elif tree is None:
         pass
     else:
-        flat[prefix.rstrip(SEP)] = np.asarray(jax.device_get(tree))
+        flat[prefix.rstrip(SEP)] = _to_host(tree)
     return flat
 
 
@@ -83,12 +99,16 @@ def _atomic_write(path: Path, write_fn):
 
 # ---------------------------------------------------------------------- npz --
 def save_npz(path, tree, meta: Optional[dict] = None):
-    """Chief-only atomic save of a pytree (params or {params,state,...})."""
+    """Chief-only atomic save of a pytree (params or {params,state,...}).
+
+    Flattening runs on every process BEFORE the chief gate: gathering a
+    tensor-parallel leaf that spans processes is a collective, so all
+    processes must participate even though only the chief writes."""
     path = Path(path)
+    flat = flatten_tree(tree)
     if not _is_chief():
         return path
     path.parent.mkdir(parents=True, exist_ok=True)
-    flat = flatten_tree(tree)
     if meta is not None:
         flat["__meta__"] = np.frombuffer(
             json.dumps(meta).encode(), dtype=np.uint8
@@ -113,13 +133,14 @@ def export_hdf5(path, params, attrs: Optional[dict] = None):
     import h5py
 
     path = Path(path)
+    flat = flatten_tree(params)  # before the chief gate: may be collective
     if not _is_chief():
         return path
     path.parent.mkdir(parents=True, exist_ok=True)
 
     def write(tmp):
         with h5py.File(tmp, "w") as f:
-            for key, val in flatten_tree(params).items():
+            for key, val in flat.items():
                 f.create_dataset(key, data=val)
             for k, v in (attrs or {}).items():
                 f.attrs[k] = v
@@ -230,8 +251,9 @@ class Checkpointer:
         tree, meta = load_npz(self._path(step))
         if not model.built:
             model.build(meta["input_shape"], seed=meta.get("seed", 0))
-        hints = getattr(model, "_param_hints", None)
-        model.params = model.strategy.put_params(tree["params"], hints=hints)
+        model.params = model.strategy.put_params(
+            tree["params"], hints=model._param_hints
+        )
         model.state = model.strategy.put_params(tree.get("state") or {})
         if model.compiled and tree.get("opt_state") is not None:
             # npz round-trips optax's NamedTuple state as plain tuples/dicts;
@@ -338,7 +360,7 @@ class Checkpointer:
 
         model.params = model.strategy.put_params(
             graft(model.params, p_leaves),
-            hints=getattr(model, "_param_hints", None),
+            hints=model._param_hints,
         )
         if ck_s:
             model.state = model.strategy.put_params(

@@ -37,14 +37,21 @@ class MultiHeadAttention(Layer):
         causal: bool = False,
         use_bias: bool = True,
         dtype=None,
+        ring_axis: Optional[str] = "seq",
         name: Optional[str] = None,
     ):
+        """``ring_axis``: when the ambient strategy's mesh has this axis with
+        size > 1 (sequence parallelism), attention runs as ring attention
+        over it (ops.ring_attention) — K/V rotate between sequence shards
+        instead of being all-gathered. Irrelevant (dense path) otherwise;
+        set None to force dense attention even under a seq mesh."""
         super().__init__(name)
         self.num_heads = int(num_heads)
         self.head_dim = head_dim
         self.causal = bool(causal)
         self.use_bias = use_bias
         self.dtype = dtype
+        self.ring_axis = ring_axis
 
     def init(self, key, input_shape: Shape):
         d = input_shape[-1]
@@ -77,6 +84,25 @@ class MultiHeadAttention(Layer):
             hints.update(bq="col", bk="col", bv="col")
         return hints
 
+    def _ring_config(self):
+        """(mesh, batch_axis) when sequence-parallel ring attention should
+        run, else None. Reads the ambient strategy at trace time (Model
+        enters its strategy scope around step tracing)."""
+        if self.ring_axis is None:
+            return None
+        from ..parallel.strategy import current_strategy
+
+        strat = current_strategy()
+        mesh = getattr(strat, "mesh", None)
+        if mesh is None or self.ring_axis not in mesh.axis_names:
+            return None
+        if int(mesh.shape[self.ring_axis]) <= 1:
+            return None
+        batch_axis = getattr(strat, "axis", None)
+        if batch_axis not in mesh.axis_names:
+            batch_axis = None
+        return mesh, batch_axis
+
     def _proj(self, params, x, w, b):
         kernel = params[w]
         if self.dtype is not None:
@@ -95,6 +121,22 @@ class MultiHeadAttention(Layer):
         q = self._proj(params, x, "wq", "bq").reshape(b, t, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(b, t, h, hd)
         v = self._proj(params, x, "wv", "bv").reshape(b, t, h, hd)
+        ring = self._ring_config()
+        if ring is not None:
+            from ..ops.ring_attention import ring_attention
+
+            mesh, batch_axis = ring
+            ctx = ring_attention(
+                q, k, v,
+                mesh=mesh,
+                seq_axis=self.ring_axis,
+                batch_axis=batch_axis,
+                causal=self.causal,
+            ).reshape(b, t, h * hd)
+            out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+            if self.use_bias:
+                out = out + params["bo"].astype(out.dtype)
+            return out, {}
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.float32(hd))

@@ -1,6 +1,7 @@
 from .mesh import AXES, batch_sharding, make_mesh, replicated
 from .strategy import (
     DataParallel,
+    DataSeqParallel,
     DataTensorParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
@@ -16,6 +17,7 @@ __all__ = [
     "Strategy",
     "SingleDevice",
     "DataParallel",
+    "DataSeqParallel",
     "DataTensorParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",

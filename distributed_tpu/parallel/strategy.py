@@ -39,6 +39,24 @@ def current_strategy() -> Optional["Strategy"]:
     return getattr(_local, "strategy", None)
 
 
+def _put_global(x, sh: NamedSharding):
+    """Place one host-global array under `sh`. Multi-host: each process
+    keeps its contiguous row-slice and the slices assemble into one global
+    sharded array (the single implementation every strategy's put_batch
+    delegates to)."""
+    x = np.asarray(x)
+    if jax.process_count() > 1:
+        p, nproc = jax.process_index(), jax.process_count()
+        rows = x.shape[0]
+        if rows % nproc:
+            raise ValueError(
+                f"Global batch {rows} not divisible by {nproc} processes"
+            )
+        local = x[p * rows // nproc : (p + 1) * rows // nproc]
+        return jax.make_array_from_process_local_data(sh, local, x.shape)
+    return jax.device_put(x, sh)
+
+
 class Strategy:
     """Base strategy: knows the mesh and how to place params and batches."""
 
@@ -140,21 +158,7 @@ class DataParallel(Strategy):
         slices assemble into one global sharded array (per-host input
         sharding, SURVEY.md §7 hard parts)."""
         sh = self.batch_sharding()
-
-        def _put(x):
-            x = np.asarray(x)
-            if jax.process_count() > 1:
-                p, nproc = jax.process_index(), jax.process_count()
-                rows = x.shape[0]
-                if rows % nproc:
-                    raise ValueError(
-                        f"Global batch {rows} not divisible by {nproc} processes"
-                    )
-                local = x[p * rows // nproc : (p + 1) * rows // nproc]
-                return jax.make_array_from_process_local_data(sh, local, x.shape)
-            return jax.device_put(x, sh)
-
-        return jax.tree_util.tree_map(_put, batch)
+        return jax.tree_util.tree_map(lambda x: _put_global(x, sh), batch)
 
     def local_batch_size(self, global_batch: int) -> int:
         n = self.num_replicas_in_sync
@@ -245,6 +249,69 @@ class DataTensorParallel(DataParallel):
             return jax.device_put(a, rep)
 
         return jax.tree_util.tree_map(place, opt)
+
+
+class DataSeqParallel(DataParallel):
+    """Sequence (context) parallelism composed with data parallelism.
+
+    Batches shard on 'data' AND their sequence (second) dimension on 'seq',
+    so per-device activation memory is O(T / seq_parallel) — the long-
+    context axis the reference never had (SURVEY.md §5: "the mesh design
+    should merely not preclude adding a sequence axis" — here it is).
+    MultiHeadAttention detects the seq axis at trace time and runs ring
+    attention over it (ops.ring_attention): K/V blocks hop neighbor-to-
+    neighbor over ICI instead of being all-gathered. Params replicated;
+    gradient all-reduce spans both axes (every device holds a full replica).
+    """
+
+    def __init__(
+        self,
+        devices=None,
+        *,
+        mesh: Optional[Mesh] = None,
+        seq_parallel: int = 2,
+        axis: str = "data",
+        seq_axis: str = "seq",
+    ):
+        if mesh is None:
+            ndev = len(devices or jax.devices())
+            if ndev % seq_parallel:
+                raise ValueError(
+                    f"{ndev} devices not divisible by seq_parallel="
+                    f"{seq_parallel}"
+                )
+            mesh = make_mesh(
+                {axis: ndev // seq_parallel, seq_axis: seq_parallel},
+                devices=devices,
+            )
+        super().__init__(mesh=mesh, axis=axis)
+        if seq_axis not in mesh.axis_names:
+            raise ValueError(f"Mesh {mesh.axis_names} has no axis {seq_axis!r}")
+        self.seq_axis = seq_axis
+
+    def batch_sharding(self):
+        # Rank-dependent: applied per-leaf in put_batch.
+        return NamedSharding(self.mesh, PartitionSpec(self.axis, self.seq_axis))
+
+    def put_batch(self, batch):
+        def _put(x):
+            x = np.asarray(x)
+            if x.ndim >= 2:
+                seq_len = x.shape[1]
+                n_seq = int(self.mesh.shape[self.seq_axis])
+                if seq_len % n_seq:
+                    raise ValueError(
+                        f"sequence length {seq_len} not divisible by "
+                        f"{self.seq_axis}={n_seq} shards"
+                    )
+                spec = PartitionSpec(
+                    self.axis, self.seq_axis, *([None] * (x.ndim - 2))
+                )
+            else:
+                spec = PartitionSpec(self.axis)
+            return _put_global(x, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(_put, batch)
 
 
 # Alias keeping the reference's class name greppable for migrating users.

@@ -161,7 +161,7 @@ class EarlyStopping(Callback):
         if self.restore_best and self._best_params is not None:
             model.params = model.strategy.put_params(
                 self._best_params,
-                hints=getattr(model, "_param_hints", None),
+                hints=model._param_hints,
             )
             model.state = model.strategy.put_params(self._best_state)
 

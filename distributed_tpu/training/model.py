@@ -83,6 +83,7 @@ class Model:
         self.step = 0  # global optimizer step (checkpoint/resume cursor)
         self.stop_training = False  # callbacks (EarlyStopping) set this
         self._resumed_step = None  # set by a restoring ModelCheckpoint
+        self._param_hints = {}  # TP role tree, populated by build()
         self._seed = 0
         self._train_step = None
         self._eval_step = None
@@ -148,8 +149,21 @@ class Model:
             mvals = {name: fn(logits, y) for name, fn in metric_fns}
             return new_params, new_state, new_opt, loss, mvals
 
-        self._train_step = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._train_step = self._scoped(jax.jit(step, donate_argnums=(0, 1, 2)))
         return self._train_step
+
+    def _scoped(self, jitted):
+        """Run the jitted fn with this model's strategy as the ambient
+        strategy: jit traces on first call, and trace-time code (e.g.
+        MultiHeadAttention's ring-attention detection) reads
+        current_strategy(). Per-call cost is a thread-local set/reset."""
+        strategy = self.strategy
+
+        def call(*args):
+            with strategy.scope():
+                return jitted(*args)
+
+        return call
 
     def _get_eval_step(self):
         if self._eval_step is not None:
@@ -177,7 +191,7 @@ class Model:
                     msums[name] = (s * valid / jnp.maximum(c, 1.0), valid)
             return loss_sum, valid, msums
 
-        self._eval_step = jax.jit(step)
+        self._eval_step = self._scoped(jax.jit(step))
         return self._eval_step
 
     def _get_predict_step(self):
@@ -189,7 +203,7 @@ class Model:
             logits, _ = module.apply(params, state, x, train=False)
             return logits
 
-        self._predict_step = jax.jit(step)
+        self._predict_step = self._scoped(jax.jit(step))
         return self._predict_step
 
     def _step_rng(self):
