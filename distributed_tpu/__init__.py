@@ -34,6 +34,7 @@ from .parallel.strategy import (
     DataParallel,
     DataSeqParallel,
     DataTensorParallel,
+    FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
@@ -51,6 +52,7 @@ __all__ = [
     "DataParallel",
     "DataSeqParallel",
     "DataTensorParallel",
+    "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",
     "make_mesh",

@@ -32,6 +32,19 @@ try:  # modern location (jax>=0.8)
 except ImportError:  # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map
 
+import inspect
+
+# Replication checking was renamed check_rep -> check_vma in jax 0.8;
+# resolve the kwarg once at import, not per call.
+_sig = inspect.signature(shard_map).parameters
+if "check_vma" in _sig:
+    _CHECK_KWARGS = {"check_vma": False}
+elif "check_rep" in _sig:  # pragma: no cover — older jax
+    _CHECK_KWARGS = {"check_rep": False}
+else:  # pragma: no cover
+    _CHECK_KWARGS = {}
+del _sig
+
 
 def ring_attention(
     q,
@@ -120,12 +133,10 @@ def ring_attention(
         out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, H, Tb, D)
         return jnp.transpose(out, (0, 2, 1, 3)).astype(ql.dtype)
 
-    import inspect
-
-    kwargs = {"mesh": mesh, "in_specs": (spec, spec, spec), "out_specs": spec}
-    sig = inspect.signature(shard_map).parameters
-    if "check_vma" in sig:  # jax>=0.8 name
-        kwargs["check_vma"] = False
-    elif "check_rep" in sig:  # older name
-        kwargs["check_rep"] = False
-    return shard_map(local_fn, **kwargs)(q, k, v)
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **_CHECK_KWARGS,
+    )(q, k, v)

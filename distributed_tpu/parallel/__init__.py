@@ -3,6 +3,7 @@ from .strategy import (
     DataParallel,
     DataSeqParallel,
     DataTensorParallel,
+    FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
@@ -19,6 +20,7 @@ __all__ = [
     "DataParallel",
     "DataSeqParallel",
     "DataTensorParallel",
+    "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",
     "current_strategy",
 ]
