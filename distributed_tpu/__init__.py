@@ -33,6 +33,7 @@ from .parallel.mesh import make_mesh
 from .parallel.strategy import (
     DataParallel,
     DataSeqParallel,
+    DataExpertParallel,
     DataTensorParallel,
     FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
@@ -51,6 +52,7 @@ __all__ = [
     "SingleDevice",
     "DataParallel",
     "DataSeqParallel",
+    "DataExpertParallel",
     "DataTensorParallel",
     "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",

@@ -18,9 +18,18 @@ from .. import nn
 
 
 def transformer_block(
-    d_model: int, num_heads: int, d_ff: int, *, causal: bool = True, dtype=None
+    d_model: int,
+    num_heads: int,
+    d_ff: int,
+    *,
+    causal: bool = True,
+    moe_experts: int = 0,
+    dtype=None,
 ) -> list:
-    """Pre-LN block as two Residuals: [LN -> MHA] + [LN -> MLP]."""
+    """Pre-LN block as two Residuals: [LN -> MHA] + [LN -> MLP-or-MoE].
+
+    ``moe_experts > 0`` swaps the dense MLP for an nn.MoE with that many
+    experts (expert-parallel under DataExpertParallel)."""
     attn = nn.Residual(
         nn.Sequential(
             [
@@ -30,16 +39,17 @@ def transformer_block(
             name="main",
         )
     )
-    mlp = nn.Residual(
-        nn.Sequential(
-            [
-                nn.LayerNorm(),
-                nn.Dense(d_ff, activation="gelu", shard="col", dtype=dtype),
-                nn.Dense(d_model, shard="row", dtype=dtype),
-            ],
-            name="main",
-        )
-    )
+    if moe_experts:
+        ffn_layers = [nn.LayerNorm(), nn.MoE(moe_experts, d_ff, dtype=dtype)]
+    else:
+        # Flat layer list (not nested in a named container): the param tree
+        # paths residual_N/main/{dense,dense_1} are a checkpoint format.
+        ffn_layers = [
+            nn.LayerNorm(),
+            nn.Dense(d_ff, activation="gelu", shard="col", dtype=dtype),
+            nn.Dense(d_model, shard="row", dtype=dtype),
+        ]
+    mlp = nn.Residual(nn.Sequential(ffn_layers, name="main"))
     return [attn, mlp]
 
 
@@ -52,21 +62,26 @@ def transformer_lm(
     d_ff: Optional[int] = None,
     max_len: int = 512,
     causal: bool = True,
+    moe_experts: int = 0,
+    moe_every: int = 2,
     dtype=None,
 ) -> nn.Sequential:
     """Token-in, logits-out LM: (B, T) int32 -> (B, T, vocab).
 
     Train with ``loss="sparse_categorical_crossentropy"`` (or the fused
     ``"pallas_sparse_categorical_crossentropy"``) on next-token labels.
+    ``moe_experts > 0`` makes every ``moe_every``-th block's FFN a MoE.
     """
     d_ff = d_ff or 4 * d_model
     layers = [
         nn.Embedding(vocab_size, d_model, dtype=dtype),
         nn.PositionalEmbedding(max_len),
     ]
-    for _ in range(num_layers):
+    for i in range(num_layers):
+        moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0
         layers += transformer_block(
-            d_model, num_heads, d_ff, causal=causal, dtype=dtype
+            d_model, num_heads, d_ff, causal=causal, moe_experts=moe,
+            dtype=dtype,
         )
     layers += [nn.LayerNorm(), nn.Dense(vocab_size, dtype=dtype)]
     return nn.Sequential(layers, name="transformer_lm")

@@ -1,4 +1,5 @@
 from .attention import MultiHeadAttention, PositionalEmbedding
+from .moe import MoE
 from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
     Activation,
@@ -31,5 +32,6 @@ __all__ = [
     "Dropout",
     "Embedding",
     "MultiHeadAttention",
+    "MoE",
     "PositionalEmbedding",
 ]

@@ -1,0 +1,188 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Not in the reference (dense CNN only — SURVEY.md §2c "Expert parallelism
+(EP / MoE): NO"); built so the 'expert' mesh axis (parallel.mesh.AXES) is a
+working capability, not a reserved name.
+
+TPU-first design choices:
+- **Dense dispatch** (Shazeer-style einsum with one-hot combine tensors):
+  no sorting, no dynamic shapes, no scatter — everything is static-shape
+  einsums that tile onto the MXU and jit into one XLA program.
+- **Capacity factor**: each expert processes a fixed ``capacity`` tokens per
+  batch; overflow tokens are dropped from that expert (their combine weight
+  is zero, so they pass through the residual unchanged in a transformer
+  block). Static capacity is what makes the computation shape-static.
+- **Expert parallelism**: expert weight stacks are (E, din, dout); the
+  sharding hint 'expert' splits dim 0 across the 'expert' mesh axis, and
+  GSPMD turns the dispatch/combine einsums into all-to-alls over ICI.
+- Router computes in float32; a load-balancing auxiliary loss (Switch
+  Transformer's fraction*probability form) is returned in state under
+  ``"aux_loss"`` so training can add it to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import initializers
+from .core import Layer, Shape
+
+
+class MoE(Layer):
+    """Token-choice top-k MoE over (B, T, D) or (B, D) inputs.
+
+    Output shape == input shape (experts are D -> hidden -> D MLPs).
+    """
+
+    def __init__(
+        self,
+        num_experts: int,
+        hidden_dim: int,
+        *,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        group_size: int = 1024,
+        activation: str = "gelu",
+        aux_loss_weight: float = 0.01,
+        dtype=None,
+        name: Optional[str] = None,
+    ):
+        """``group_size``: tokens are routed within fixed-size groups (the
+        Mesh-TF/Switch formulation) so the dispatch/combine one-hots are
+        O(tokens * group * k), linear in batch tokens — global routing would
+        be quadratic. Capacity is per group."""
+        super().__init__(name)
+        if top_k < 1 or top_k > num_experts:
+            raise ValueError(
+                f"top_k must be in [1, num_experts={num_experts}], got {top_k}"
+            )
+        self.num_experts = int(num_experts)
+        self.hidden_dim = int(hidden_dim)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.group_size = int(group_size)
+        self.activation = activation
+        self.aux_loss_weight = float(aux_loss_weight)
+        self.dtype = dtype
+
+    def default_name(self) -> str:
+        return "moe"  # the camel-case splitter would produce "mo_e"
+
+    def init(self, key, input_shape: Shape):
+        d = input_shape[-1]
+        e, h = self.num_experts, self.hidden_dim
+        k_router, k_in, k_out = jax.random.split(key, 3)
+        glorot = initializers.get("glorot_uniform")
+        params = {
+            "router": glorot(k_router, (d, e), jnp.float32),
+            "w_in": glorot(k_in, (e, d, h), jnp.float32),
+            "b_in": jnp.zeros((e, h), jnp.float32),
+            "w_out": glorot(k_out, (e, h, d), jnp.float32),
+            "b_out": jnp.zeros((e, d), jnp.float32),
+        }
+        # aux_loss lives in state from init so the state STRUCTURE never
+        # changes between a fresh model and one that has stepped (checkpoint
+        # restore compares structures).
+        return params, {"aux_loss": jnp.float32(0.0)}, tuple(input_shape)
+
+    def sharding_hints(self):
+        # dim 0 (the expert stack) splits across the 'expert' mesh axis.
+        return {
+            "w_in": "expert",
+            "b_in": "expert",
+            "w_out": "expert",
+            "b_out": "expert",
+        }
+
+    def _group_size(self, n_tokens: int) -> int:
+        # Largest divisor of n_tokens not exceeding group_size (all static).
+        for g in range(min(self.group_size, n_tokens), 0, -1):
+            if n_tokens % g == 0:
+                return g
+        return n_tokens
+
+    def _capacity(self, group: int) -> int:
+        c = int(self.capacity_factor * self.top_k * group
+                / self.num_experts) or 1
+        return min(c, group)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        from . import activations
+
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        b, t, d = x.shape
+        n = b * t
+        e, k = self.num_experts, self.top_k
+        g = self._group_size(n)
+        ng = n // g  # number of routing groups
+        cap = self._capacity(g)
+        act = activations.get(self.activation)
+
+        tokens = x.reshape(ng, g, d)
+        logits = jnp.einsum(
+            "Gnd,de->Gne",
+            tokens.astype(jnp.float32),
+            params["router"],
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)  # (G, g, e)
+
+        # Top-k expert choice per token; renormalized gate weights.
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (G, g, k)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        )
+
+        # Position of each (token, choice) in its expert's per-group buffer;
+        # tokens beyond capacity are dropped (combine weight zeroed).
+        choice_onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # (G,g,k,e)
+        pos = (
+            jnp.cumsum(choice_onehot.reshape(ng, g * k, e), axis=1) - 1.0
+        ).reshape(ng, g, k, e)
+        within = pos < cap
+        dispatch_w = choice_onehot * within  # (G, g, k, e)
+        pos_onehot = jax.nn.one_hot(
+            (pos * choice_onehot).sum(-1).astype(jnp.int32), cap,
+            dtype=jnp.float32,
+        )  # (G, g, k, cap)
+        # dispatch[G, n, e, c] = 1 iff group-G token n sits in slot c of
+        # expert e's buffer for that group.
+        dispatch = jnp.einsum("Gnke,Gnkc->Gnec", dispatch_w, pos_onehot)
+        combine = jnp.einsum("Gnk,Gnke,Gnkc->Gnec", gate_vals, dispatch_w,
+                             pos_onehot)
+
+        # Expert buffers: (G, e, cap, d) -> MLP -> back. All MXU einsums.
+        compute_dtype = self.dtype or tokens.dtype
+        buf = jnp.einsum(
+            "Gnec,Gnd->Gecd", dispatch.astype(compute_dtype),
+            tokens.astype(compute_dtype),
+        )
+        hid = act(
+            jnp.einsum("Gecd,edh->Gech", buf,
+                       params["w_in"].astype(compute_dtype))
+            + params["b_in"][None, :, None].astype(compute_dtype)
+        )
+        out_buf = (
+            jnp.einsum("Gech,ehd->Gecd", hid,
+                       params["w_out"].astype(compute_dtype))
+            + params["b_out"][None, :, None].astype(compute_dtype)
+        )
+        out = jnp.einsum(
+            "Gnec,Gecd->Gnd", combine.astype(compute_dtype), out_buf
+        )
+
+        # Switch-style load-balance loss: E * sum_e fraction_e * prob_e,
+        # averaged over all tokens.
+        frac = jnp.mean(choice_onehot[:, :, 0], axis=(0, 1))  # top-1 share
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        aux = self.aux_loss_weight * e * jnp.sum(frac * mean_prob)
+
+        out = out.reshape(b, t, d).astype(x.dtype)
+        if squeeze:
+            out = out[:, 0]
+        return out, {"aux_loss": aux}

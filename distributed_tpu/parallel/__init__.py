@@ -2,6 +2,7 @@ from .mesh import AXES, batch_sharding, make_mesh, replicated
 from .strategy import (
     DataParallel,
     DataSeqParallel,
+    DataExpertParallel,
     DataTensorParallel,
     FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
@@ -19,6 +20,7 @@ __all__ = [
     "SingleDevice",
     "DataParallel",
     "DataSeqParallel",
+    "DataExpertParallel",
     "DataTensorParallel",
     "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",

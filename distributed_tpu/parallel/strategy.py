@@ -164,7 +164,50 @@ class DataParallel(Strategy):
         return global_batch // n
 
 
-class DataTensorParallel(DataParallel):
+class _HintedParallel(DataParallel):
+    """Shared machinery for strategies that translate layer sharding hints
+    (nn.Layer.sharding_hints role strings) into NamedShardings. Subclasses
+    define ``_role_spec(role, ndim)``."""
+
+    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+        raise NotImplementedError
+
+    def params_sharding(self, params, hints=None):
+        def walk(p, h):
+            if isinstance(p, dict):
+                return {
+                    k: walk(v, h.get(k, {}) if isinstance(h, dict) else {})
+                    for k, v in p.items()
+                }
+            role = h if isinstance(h, str) else None
+            return NamedSharding(self.mesh, self._role_spec(role, p.ndim))
+
+        return walk(params, hints or {})
+
+    def put_params(self, params, hints=None):
+        if hints:
+            return jax.device_put(params, self.params_sharding(params, hints))
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return jax.device_put(params, rep)
+
+    def init_opt_state(self, tx, params):
+        # Eager init: zeros_like/stat tensors inherit each parameter's
+        # NamedSharding directly (a jitted init would lose it — the outputs
+        # have no value dependence on the inputs, so GSPMD unpins them).
+        # Leaves created from scratch (step counters etc.) get replicated.
+        opt = tx.init(params)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def place(a):
+            sh = getattr(a, "sharding", None)
+            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
+                return a
+            return jax.device_put(a, rep)
+
+        return jax.tree_util.tree_map(place, opt)
+
+
+class DataTensorParallel(_HintedParallel):
     """2-axis parallelism: batch sharded over 'data', weight matrices of
     hinted layers (Dense(shard=...), MultiHeadAttention) Megatron-sharded
     over 'model'.
@@ -211,42 +254,51 @@ class DataTensorParallel(DataParallel):
             return PartitionSpec(*([m] + [None] * (ndim - 1)))
         return PartitionSpec()
 
-    def params_sharding(self, params, hints=None):
-        def walk(p, h):
-            if isinstance(p, dict):
-                return {
-                    k: walk(v, h.get(k, {}) if isinstance(h, dict) else {})
-                    for k, v in p.items()
-                }
-            role = h if isinstance(h, str) else None
-            return NamedSharding(self.mesh, self._role_spec(role, p.ndim))
 
-        return walk(params, hints or {})
+class DataExpertParallel(_HintedParallel):
+    """Expert parallelism composed with data parallelism: MoE expert stacks
+    (nn.MoE's (E, ...) parameters, hint role 'expert') shard dim 0 over the
+    'expert' mesh axis while the batch shards over 'data'. GSPMD lowers the
+    dispatch/combine einsums to all-to-alls over ICI. Dense (non-expert)
+    params stay replicated. Not in the reference (SURVEY.md §2c "EP: NO").
+    """
 
-    def put_params(self, params, hints=None):
-        if hints:
-            return jax.device_put(params, self.params_sharding(params, hints))
-        rep = NamedSharding(self.mesh, PartitionSpec())
-        return jax.device_put(params, rep)
+    def __init__(
+        self,
+        devices=None,
+        *,
+        mesh: Optional[Mesh] = None,
+        expert_parallel: int = 2,
+        axis: str = "data",
+        expert_axis: str = "expert",
+    ):
+        if mesh is None:
+            ndev = len(devices or jax.devices())
+            if ndev % expert_parallel:
+                raise ValueError(
+                    f"{ndev} devices not divisible by expert_parallel="
+                    f"{expert_parallel}"
+                )
+            mesh = make_mesh(
+                {axis: ndev // expert_parallel, expert_axis: expert_parallel},
+                devices=devices,
+            )
+        super().__init__(mesh=mesh, axis=axis)
+        if expert_axis not in mesh.axis_names:
+            raise ValueError(
+                f"Mesh {mesh.axis_names} has no axis {expert_axis!r}"
+            )
+        self.expert_axis = expert_axis
 
-    def init_opt_state(self, tx, params):
-        # Eager init: zeros_like/stat tensors inherit each parameter's
-        # NamedSharding directly (a jitted init would lose it — the outputs
-        # have no value dependence on the inputs, so GSPMD unpins them).
-        # Leaves created from scratch (step counters etc.) get replicated.
-        opt = tx.init(params)
-        rep = NamedSharding(self.mesh, PartitionSpec())
-
-        def place(a):
-            sh = getattr(a, "sharding", None)
-            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
-                return a
-            return jax.device_put(a, rep)
-
-        return jax.tree_util.tree_map(place, opt)
+    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+        if role == "expert":  # shard the expert stack (dim 0)
+            return PartitionSpec(
+                *([self.expert_axis] + [None] * (ndim - 1))
+            )
+        return PartitionSpec()
 
 
-class FullyShardedDataParallel(DataParallel):
+class FullyShardedDataParallel(_HintedParallel):
     """ZeRO-3-style fully sharded data parallelism over the 'fsdp' axis.
 
     Every parameter (and its optimizer state) is sharded across the axis on
@@ -290,20 +342,8 @@ class FullyShardedDataParallel(DataParallel):
 
     def put_params(self, params, hints=None):
         return jax.device_put(params, self.params_sharding(params))
-
-    def init_opt_state(self, tx, params):
-        # Same eager-init rationale as DataTensorParallel: stat tensors
-        # inherit their parameter's sharding; fresh scalars get replicated.
-        opt = tx.init(params)
-        rep = NamedSharding(self.mesh, PartitionSpec())
-
-        def place(a):
-            sh = getattr(a, "sharding", None)
-            if isinstance(sh, NamedSharding) and sh.mesh == self.mesh:
-                return a
-            return jax.device_put(a, rep)
-
-        return jax.tree_util.tree_map(place, opt)
+    # init_opt_state inherited from _HintedParallel (eager init: stats
+    # inherit their parameter's sharding, fresh scalars replicate).
 
 
 class DataSeqParallel(DataParallel):

@@ -41,6 +41,16 @@ from ..utils.tree import tree_size
 from .history import History
 
 
+def _aux_loss_sum(state):
+    """Sum of all leaves named 'aux_loss' anywhere in a state tree."""
+    total = 0.0
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves:
+        if path and getattr(path[-1], "key", None) == "aux_loss":
+            total = total + leaf
+    return total
+
+
 def _index_stream(
     n: int, batch: int, shuffle: bool, seed: Optional[int], start_step: int = 0
 ):
@@ -139,7 +149,13 @@ class Model:
         def step(params, state, opt_state, x, y, rng):
             def loss_f(p):
                 logits, new_state = module.apply(p, state, x, train=True, rng=rng)
-                return loss_fn(logits, y), (new_state, logits)
+                # Layers may report auxiliary objectives (e.g. MoE router
+                # load-balance loss) through state keys named "aux_loss";
+                # they join the objective so their gradients flow.
+                return (
+                    loss_fn(logits, y) + _aux_loss_sum(new_state),
+                    (new_state, logits),
+                )
 
             (loss, (new_state, logits)), grads = jax.value_and_grad(
                 loss_f, has_aux=True
@@ -173,7 +189,7 @@ class Model:
         per_ex = losses_lib.get_per_example(self.loss_fn)
 
         def step(params, state, x, y, mask):
-            logits, _ = module.apply(params, state, x, train=False)
+            logits, new_state = module.apply(params, state, x, train=False)
             valid = jnp.sum(mask)
             if per_ex is not None:
                 loss_sum = jnp.sum(per_ex(logits, y) * mask)
@@ -181,6 +197,10 @@ class Model:
                 # Custom loss without a per-example form: whole-batch mean
                 # weighted by valid count (exact when the batch is unpadded).
                 loss_sum = loss_fn(logits, y) * valid
+            # Keep evaluate() measuring the trained objective: auxiliary
+            # losses (MoE load balance) join here too. (On a padded final
+            # batch the aux term sees the pad rows — a small approximation.)
+            loss_sum = loss_sum + _aux_loss_sum(new_state) * valid
             msums = {}
             for name, fn in metric_fns:
                 scores = metrics_lib.per_example(fn)
