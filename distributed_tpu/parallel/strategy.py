@@ -175,8 +175,11 @@ class _HintedParallel(DataParallel):
     def params_sharding(self, params, hints=None):
         def walk(p, h):
             if isinstance(p, dict):
+                # A string role at container level applies to the whole
+                # subtree (e.g. PipelinedBlocks marks its stacked params
+                # {"blocks": "pipe"}).
                 return {
-                    k: walk(v, h.get(k, {}) if isinstance(h, dict) else {})
+                    k: walk(v, h.get(k, {}) if isinstance(h, dict) else h)
                     for k, v in p.items()
                 }
             role = h if isinstance(h, str) else None
