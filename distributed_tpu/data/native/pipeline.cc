@@ -126,7 +126,8 @@ struct DtpuPipeline {
       for (int64_t e = 0; e < row; ++e) out[e] = (float)in[e] * scale;
       slot.y[(size_t)b] = y ? y[src] : 0;
     }
-    slot.step = step;
+    // slot.step is published under mu in worker(): the consumer's wait
+    // predicate reads it, and an unlocked write here would race.
   }
 
   void worker() {
@@ -144,6 +145,7 @@ struct DtpuPipeline {
       fill(slot, step);
       {
         std::lock_guard<std::mutex> lock(mu);
+        slot.step = step;
         slot.filled = true;
       }
       cv_consume.notify_all();

@@ -98,11 +98,11 @@ class MoE(Layer):
         }
 
     def _group_size(self, n_tokens: int) -> int:
-        # Largest divisor of n_tokens not exceeding group_size (all static).
-        for g in range(min(self.group_size, n_tokens), 0, -1):
-            if n_tokens % g == 0:
-                return g
-        return n_tokens
+        # Groups are always full-width: awkward token counts (primes, odd
+        # batch*seq products) are PADDED up to a group boundary rather than
+        # shrinking the group — a tiny group would collapse capacity to ~1
+        # and silently drop most routing choices.
+        return min(self.group_size, n_tokens)
 
     def _capacity(self, group: int) -> int:
         c = int(self.capacity_factor * self.top_k * group
@@ -119,11 +119,20 @@ class MoE(Layer):
         n = b * t
         e, k = self.num_experts, self.top_k
         g = self._group_size(n)
-        ng = n // g  # number of routing groups
+        ng = -(-n // g)  # number of routing groups (ceil)
+        n_pad = ng * g
         cap = self._capacity(g)
         act = activations.get(self.activation)
 
-        tokens = x.reshape(ng, g, d)
+        flat = x.reshape(n, d)
+        if n_pad != n:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((n_pad - n, d), flat.dtype)], axis=0
+            )
+        tokens = flat.reshape(ng, g, d)
+        # (G, g) validity mask; pad tokens are excluded from dispatch (they
+        # consume no capacity) and from the aux loss statistics.
+        valid = (jnp.arange(n_pad) < n).astype(jnp.float32).reshape(ng, g)
         logits = jnp.einsum(
             "Gnd,de->Gne",
             tokens.astype(jnp.float32),
@@ -140,7 +149,10 @@ class MoE(Layer):
 
         # Position of each (token, choice) in its expert's per-group buffer;
         # tokens beyond capacity are dropped (combine weight zeroed).
-        choice_onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # (G,g,k,e)
+        choice_onehot = (
+            jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+            * valid[:, :, None, None]
+        )  # (G,g,k,e)
         pos = (
             jnp.cumsum(choice_onehot.reshape(ng, g * k, e), axis=1) - 1.0
         ).reshape(ng, g, k, e)
@@ -177,12 +189,14 @@ class MoE(Layer):
         )
 
         # Switch-style load-balance loss: E * sum_e fraction_e * prob_e,
-        # averaged over all tokens.
-        frac = jnp.mean(choice_onehot[:, :, 0], axis=(0, 1))  # top-1 share
-        mean_prob = jnp.mean(probs, axis=(0, 1))
+        # averaged over *valid* tokens only.
+        frac = jnp.sum(choice_onehot[:, :, 0], axis=(0, 1)) / n  # top-1 share
+        mean_prob = (
+            jnp.sum(probs * valid[:, :, None], axis=(0, 1)) / n
+        )
         aux = self.aux_loss_weight * e * jnp.sum(frac * mean_prob)
 
-        out = out.reshape(b, t, d).astype(x.dtype)
+        out = out.reshape(n_pad, d)[:n].reshape(b, t, d).astype(x.dtype)
         if squeeze:
             out = out[:, 0]
         return out, {"aux_loss": aux}

@@ -141,10 +141,12 @@ class EarlyStopping(Callback):
             if self.restore_best:
                 # Deep host copies: the jitted train step DONATES param/state
                 # buffers, so stashing by reference would hold deleted arrays
-                # after the next step.
-                copy = lambda t: jax.tree_util.tree_map(
-                    lambda a: np.array(jax.device_get(a)), t
-                )
+                # after the next step. _to_host (not device_get) because
+                # multi-host-sharded leaves (TP/FSDP/EP) are not fully
+                # addressable and need a collective gather.
+                from ..checkpoint.core import _to_host
+
+                copy = lambda t: jax.tree_util.tree_map(_to_host, t)
                 self._best_params = copy(model.params)
                 self._best_state = copy(model.state)
         else:

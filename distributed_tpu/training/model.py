@@ -190,12 +190,24 @@ class Model:
 
         def step(params, state, x, y, mask):
             logits, new_state = module.apply(params, state, x, train=False)
-            valid = jnp.sum(mask)
+            # Token-level models have per-element losses of shape y.shape
+            # (e.g. (B, T) for an LM); the pad mask is per-example (B,).
+            # Broadcast it to the label rank and count *elements*, so the
+            # reported loss is a per-token mean matching the training
+            # objective (loss_fn's whole-batch mean).
+            def weights_like(elems):
+                m = mask.reshape(mask.shape + (1,) * (elems.ndim - 1))
+                return jnp.broadcast_to(m, elems.shape).astype(elems.dtype)
+
             if per_ex is not None:
-                loss_sum = jnp.sum(per_ex(logits, y) * mask)
+                loss_elems = per_ex(logits, y)
+                w = weights_like(loss_elems)
+                loss_sum = jnp.sum(loss_elems * w)
+                valid = jnp.sum(w)
             else:
                 # Custom loss without a per-example form: whole-batch mean
                 # weighted by valid count (exact when the batch is unpadded).
+                valid = jnp.sum(mask) * (y.size / y.shape[0])
                 loss_sum = loss_fn(logits, y) * valid
             # Keep evaluate() measuring the trained objective: auxiliary
             # losses (MoE load balance) join here too. (On a padded final
@@ -205,10 +217,13 @@ class Model:
             for name, fn in metric_fns:
                 scores = metrics_lib.per_example(fn)
                 if scores is not None:
-                    msums[name] = (jnp.sum(scores(logits, y) * mask), valid)
+                    s_elems = scores(logits, y)
+                    w = weights_like(s_elems)
+                    msums[name] = (jnp.sum(s_elems * w), jnp.sum(w))
                 else:
                     s, c = fn(logits, y)
-                    msums[name] = (s * valid / jnp.maximum(c, 1.0), valid)
+                    ex = jnp.sum(mask)
+                    msums[name] = (s * ex / jnp.maximum(c, 1.0), ex)
             return loss_sum, valid, msums
 
         self._eval_step = self._scoped(jax.jit(step))

@@ -59,6 +59,29 @@ class TestMoELayer:
             ref = hid @ params["w_out"][e] + params["b_out"][e]
             np.testing.assert_allclose(y[i], ref, rtol=1e-4, atol=1e-5)
 
+    def test_prime_token_count_pads_not_degenerates(self):
+        # Round-1 weakness: group size used to shrink to the largest divisor
+        # of n_tokens — 1 for primes — collapsing capacity. Now tokens pad
+        # up to a group boundary instead: for prime n=13 with group_size=8,
+        # groups stay width 8 and routing stays exact.
+        layer = _moe(e=3, h=8, top_k=1, capacity_factor=10.0, group_size=8)
+        assert layer._group_size(13) == 8  # not 1
+        params, state, _ = layer.init(jax.random.PRNGKey(10), (5,))
+        x = jax.random.normal(jax.random.PRNGKey(11), (13, 5))
+        y, st = layer.apply(params, state, x)
+        chosen = jnp.argmax(x @ params["router"], axis=-1)
+        for i in range(13):
+            e = int(chosen[i])
+            hid = jax.nn.gelu(x[i] @ params["w_in"][e] + params["b_in"][e])
+            ref = hid @ params["w_out"][e] + params["b_out"][e]
+            np.testing.assert_allclose(y[i], ref, rtol=1e-4, atol=1e-5)
+        # aux loss is averaged over valid tokens only: a uniform router
+        # should give ~weight*1 regardless of padding.
+        uniform = dict(params, router=jnp.zeros_like(params["router"]))
+        _, st_u = layer.apply(uniform, state, x)
+        assert float(st_u["aux_loss"]) == pytest.approx(
+            layer.aux_loss_weight, rel=1e-5)
+
     def test_capacity_drops_overflow(self):
         # capacity_factor tiny -> cap = 1 slot/expert; most tokens dropped
         # (output 0 = pass-through in a residual block).
