@@ -32,6 +32,7 @@ from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
     DataParallel,
+    DataPipelineParallel,
     DataSeqParallel,
     DataExpertParallel,
     DataTensorParallel,
@@ -51,6 +52,7 @@ __all__ = [
     "Strategy",
     "SingleDevice",
     "DataParallel",
+    "DataPipelineParallel",
     "DataSeqParallel",
     "DataExpertParallel",
     "DataTensorParallel",

@@ -64,6 +64,7 @@ def transformer_lm(
     causal: bool = True,
     moe_experts: int = 0,
     moe_every: int = 2,
+    pipeline: bool = False,
     dtype=None,
 ) -> nn.Sequential:
     """Token-in, logits-out LM: (B, T) int32 -> (B, T, vocab).
@@ -71,17 +72,35 @@ def transformer_lm(
     Train with ``loss="sparse_categorical_crossentropy"`` (or the fused
     ``"pallas_sparse_categorical_crossentropy"``) on next-token labels.
     ``moe_experts > 0`` makes every ``moe_every``-th block's FFN a MoE.
+    ``pipeline=True`` stacks the blocks in an ``nn.PipelinedBlocks`` so they
+    pipeline over the 'pipe' mesh axis under ``DataPipelineParallel`` (and
+    run as a weight-stacked scan otherwise); incompatible with MoE blocks
+    (aux-loss state can't ride the microbatch schedule).
     """
     d_ff = d_ff or 4 * d_model
     layers = [
         nn.Embedding(vocab_size, d_model, dtype=dtype),
         nn.PositionalEmbedding(max_len),
     ]
-    for i in range(num_layers):
-        moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0
-        layers += transformer_block(
-            d_model, num_heads, d_ff, causal=causal, moe_experts=moe,
-            dtype=dtype,
+    if pipeline:
+        if moe_experts:
+            raise ValueError("pipeline=True does not support MoE blocks")
+        layers.append(
+            nn.PipelinedBlocks(
+                lambda: nn.Sequential(
+                    transformer_block(
+                        d_model, num_heads, d_ff, causal=causal, dtype=dtype
+                    )
+                ),
+                num_layers,
+            )
         )
+    else:
+        for i in range(num_layers):
+            moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0
+            layers += transformer_block(
+                d_model, num_heads, d_ff, causal=causal, moe_experts=moe,
+                dtype=dtype,
+            )
     layers += [nn.LayerNorm(), nn.Dense(vocab_size, dtype=dtype)]
     return nn.Sequential(layers, name="transformer_lm")

@@ -1,5 +1,6 @@
 from .attention import MultiHeadAttention, PositionalEmbedding
 from .moe import MoE
+from .pipeline import PipelinedBlocks
 from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
     Activation,
@@ -33,5 +34,6 @@ __all__ = [
     "Embedding",
     "MultiHeadAttention",
     "MoE",
+    "PipelinedBlocks",
     "PositionalEmbedding",
 ]

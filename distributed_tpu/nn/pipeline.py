@@ -1,0 +1,242 @@
+"""Pipeline parallelism: GPipe-microbatched stage execution over 'pipe'.
+
+Absent from the reference (single model replica per worker, SURVEY.md §2c
+"Pipeline parallelism: NO"); built because the mesh promises `pipe` as a
+composable axis (parallel.mesh.AXES) and the strategy hint machinery
+anticipates a stacked-blocks layer.
+
+TPU-first design:
+
+- **Stacked stage parameters**: ``PipelinedBlocks`` holds S structurally
+  identical blocks as ONE pytree whose leaves have a leading (S, ...) stage
+  dimension — a single NamedSharding (dim 0 over 'pipe') places every stage's
+  weights on its device; there is no per-stage program or weight exchange.
+- **Schedule as data flow, not control flow**: the GPipe schedule is a
+  ``lax.scan`` over M + n - 1 ticks inside one ``shard_map``. Each tick every
+  device runs its resident stage(s) on the activation it holds and the
+  activations hop one rank along the 'pipe' axis via ``lax.ppermute`` — a
+  neighbor ICI transfer on a TPU torus. XLA sees one static program; no
+  host-side scheduler exists (contrast GPipe/PipeDream's runtime schedulers).
+- **Backward for free**: the schedule is reverse-mode differentiable
+  (scan + ppermute + psum all have transposes), so ``jax.grad`` of the jitted
+  train step yields the reverse pipeline schedule without any hand-written
+  backward pass.
+- Bubble fraction is the standard GPipe (n-1)/(M+n-1); raise
+  ``num_microbatches`` on the strategy to amortize.
+
+Single-device (no 'pipe' axis in the ambient strategy) the same layer runs
+its blocks as a weight-stacked ``lax.scan`` — one trace of the block instead
+of S inlined copies, which keeps compile time flat in depth.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from .core import Layer, Shape
+
+try:  # modern location (jax>=0.8)
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+import inspect
+
+_sig = inspect.signature(shard_map).parameters
+if "check_vma" in _sig:
+    _CHECK_KWARGS = {"check_vma": False}
+elif "check_rep" in _sig:  # pragma: no cover — older jax
+    _CHECK_KWARGS = {"check_rep": False}
+else:  # pragma: no cover
+    _CHECK_KWARGS = {}
+del _sig
+
+
+class PipelinedBlocks(Layer):
+    """S structurally identical shape-preserving blocks, stacked for
+    pipeline parallelism.
+
+    ``block_fn()`` must return a fresh ``Layer`` with the same structure each
+    call (e.g. ``lambda: nn.Sequential(transformer_block(...))``). Blocks
+    must be shape-preserving (input shape == output shape) and stateless
+    (BatchNorm-style running stats can't ride a microbatch schedule).
+
+    Under a strategy with a 'pipe' mesh axis (``DataPipelineParallel``) the
+    stacked params shard one-stage-per-rank and apply() runs the GPipe
+    schedule; under any other strategy the same params run as a sequential
+    ``lax.scan`` — identical numerics, which is what the parity tests assert.
+    """
+
+    def __init__(
+        self,
+        block_fn: Callable[[], Layer],
+        num_blocks: int,
+        *,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self.block_fn = block_fn
+        self.block = block_fn()  # template: defines structure + names
+
+    def default_name(self) -> str:
+        return "pipelined_blocks"
+
+    @property
+    def needs_rng(self) -> bool:
+        return getattr(self.block, "needs_rng", False)
+
+    def init(self, key, input_shape: Shape):
+        shape = tuple(input_shape)
+        keys = jax.random.split(key, self.num_blocks)
+        per_stage = []
+        for i in range(self.num_blocks):
+            # Fresh instance per stage: layer naming is stateful per
+            # container, and the template must not accumulate names.
+            block = self.block if i == 0 else self.block_fn()
+            p, s, out = block.init(keys[i], shape)
+            if s:
+                raise ValueError(
+                    "PipelinedBlocks requires stateless blocks (got state "
+                    f"keys {list(s)}); running stats can't ride a "
+                    "microbatch schedule"
+                )
+            if tuple(out) != shape:
+                raise ValueError(
+                    f"Pipeline blocks must preserve shape: {shape} -> {out}"
+                )
+            per_stage.append(p)
+        params = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_stage
+        )
+        return {"blocks": params}, {}, shape
+
+    def sharding_hints(self):
+        # Container-level role string: the whole stacked subtree shards its
+        # leading (stage) dim over the 'pipe' mesh axis.
+        return {"blocks": "pipe"}
+
+    # ------------------------------------------------------------------ apply
+    def _stage_rngs(self, rng):
+        if rng is None:
+            return None
+        return jax.random.split(rng, self.num_blocks)
+
+    def _scan_blocks(self, stacked, x, *, train, rngs):
+        """Run a stack of block params over x: scan over the stage dim.
+        Shared by the sequential path (whole stack) and each pipeline rank's
+        stage (its local slice). Block outputs cast back to the input dtype
+        (the scan carry must be dtype-stable; a bf16-compute block in an f32
+        activation stream behaves like any mixed-precision layer)."""
+        block = self.block
+
+        if rngs is None:
+            def body(h, p):
+                y, _ = block.apply(p, {}, h, train=train)
+                return y.astype(h.dtype), None
+
+            x, _ = lax.scan(body, x, stacked)
+        else:
+            def body(h, pr):
+                p, r = pr
+                y, _ = block.apply(p, {}, h, train=train, rng=r)
+                return y.astype(h.dtype), None
+
+            x, _ = lax.scan(body, x, (stacked, rngs))
+        return x
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        from ..parallel.strategy import current_strategy
+
+        stacked = params["blocks"]
+        rngs = self._stage_rngs(rng)
+        strategy = current_strategy()
+        pipe_axis = getattr(strategy, "pipe_axis", None)
+        mesh = getattr(strategy, "mesh", None)
+        if (
+            pipe_axis is None
+            or mesh is None
+            or pipe_axis not in mesh.axis_names
+            or int(mesh.shape[pipe_axis]) == 1
+        ):
+            return self._scan_blocks(stacked, x, train=train, rngs=rngs), {}
+
+        n = int(mesh.shape[pipe_axis])
+        if self.num_blocks % n:
+            raise ValueError(
+                f"{self.num_blocks} blocks not divisible by "
+                f"{pipe_axis}={n} stages"
+            )
+        data_axis = getattr(strategy, "axis", "data")
+        n_data = int(mesh.shape.get(data_axis, 1))
+        m = int(getattr(strategy, "num_microbatches", n))
+        b_global = x.shape[0]
+        if b_global % (n_data * m):
+            raise ValueError(
+                f"batch {b_global} not divisible by data shards ({n_data}) "
+                f"x microbatches ({m})"
+            )
+        b_local = b_global // n_data
+        mb = b_local // m
+        feat_none = (None,) * (x.ndim - 1)
+        x_spec = PartitionSpec(data_axis, *feat_none)
+        p_specs = jax.tree_util.tree_map(
+            lambda a: PartitionSpec(pipe_axis, *((None,) * (a.ndim - 1))),
+            stacked,
+        )
+        in_specs = [p_specs, x_spec]
+        args = [stacked, x]
+        if rngs is not None:
+            in_specs.append(PartitionSpec(pipe_axis))
+            args.append(rngs)
+
+        scan_blocks = self._scan_blocks
+
+        def local_fn(p_local, x_local, *maybe_rngs):
+            r_local = maybe_rngs[0] if maybe_rngs else None
+            rank = lax.axis_index(pipe_axis)
+            mbs = x_local.reshape((m, mb) + x_local.shape[1:])
+            shift = [(j, j + 1) for j in range(n - 1)]
+
+            def tick(recv, t):
+                # Rank 0 injects microbatch t (clamped past the end: those
+                # ticks' outputs fall in the bubble and are discarded);
+                # other ranks consume what arrived from rank-1 last tick.
+                inj = lax.dynamic_index_in_dim(
+                    mbs, jnp.minimum(t, m - 1), axis=0, keepdims=False
+                )
+                h = jnp.where(rank == 0, inj, recv)
+                # Per-tick rng fold: each microbatch must draw fresh
+                # dropout masks, not reuse the stage key M times.
+                rngs_t = (
+                    None if r_local is None
+                    else jax.vmap(jax.random.fold_in, (0, None))(r_local, t)
+                )
+                y = scan_blocks(p_local, h, train=train, rngs=rngs_t)
+                return lax.ppermute(y, pipe_axis, shift), y
+
+            zeros = jnp.zeros((mb,) + x_local.shape[1:], x_local.dtype)
+            _, ys = lax.scan(tick, zeros, jnp.arange(m + n - 1))
+            # Last rank's ticks n-1 .. m+n-2 hold microbatch outputs 0..m-1.
+            outs = ys[n - 1:].reshape((b_local,) + x_local.shape[1:])
+            # Publish to every pipe rank (loss/head run replicated on pipe).
+            return lax.psum(
+                jnp.where(rank == n - 1, outs, jnp.zeros_like(outs)),
+                pipe_axis,
+            )
+
+        out = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=x_spec,
+            **_CHECK_KWARGS,
+        )(*args)
+        return out, {}

@@ -349,6 +349,82 @@ class FullyShardedDataParallel(_HintedParallel):
     # inherit their parameter's sharding, fresh scalars replicate).
 
 
+class DataPipelineParallel(_HintedParallel):
+    """Pipeline parallelism composed with data parallelism.
+
+    A model's ``nn.PipelinedBlocks`` stack shards one-stage-per-rank over the
+    'pipe' mesh axis (hint role 'pipe' = leading stage dim) and executes the
+    GPipe microbatch schedule inside the jitted train step (see
+    nn/pipeline.py); the batch shards over 'data'. Non-pipelined params
+    (embeddings, the LM head) stay replicated and compute redundantly on
+    every pipe rank — activation hops ride ICI via ppermute, and the reverse
+    schedule falls out of jax.grad. Not in the reference (single model
+    replica per worker, SURVEY.md §2c "PP: NO").
+
+    ``num_microbatches`` (default: pipe size) trades bubble fraction
+    (n-1)/(M+n-1) against per-microbatch MXU efficiency.
+    """
+
+    def __init__(
+        self,
+        devices=None,
+        *,
+        mesh: Optional[Mesh] = None,
+        pipeline_parallel: int = 2,
+        num_microbatches: Optional[int] = None,
+        axis: str = "data",
+        pipe_axis: str = "pipe",
+    ):
+        if mesh is None:
+            ndev = len(devices or jax.devices())
+            if ndev % pipeline_parallel:
+                raise ValueError(
+                    f"{ndev} devices not divisible by pipeline_parallel="
+                    f"{pipeline_parallel}"
+                )
+            mesh = make_mesh(
+                {axis: ndev // pipeline_parallel, pipe_axis: pipeline_parallel},
+                devices=devices,
+            )
+        super().__init__(mesh=mesh, axis=axis)
+        if pipe_axis not in mesh.axis_names:
+            raise ValueError(f"Mesh {mesh.axis_names} has no axis {pipe_axis!r}")
+        self.pipe_axis = pipe_axis
+        if num_microbatches is None:
+            num_microbatches = int(mesh.shape[pipe_axis])
+        if num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be >= 1, got {num_microbatches}"
+            )
+        self.num_microbatches = int(num_microbatches)
+
+    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+        if role == "pipe":  # shard the stacked stage dim (dim 0)
+            return PartitionSpec(
+                *([self.pipe_axis] + [None] * (ndim - 1))
+            )
+        return PartitionSpec()
+
+    def put_params(self, params, hints=None):
+        # Fail with a framework-level message before device_put trips over
+        # an indivisible stage stack.
+        n = int(self.mesh.shape[self.pipe_axis])
+
+        def check(p, h):
+            if isinstance(p, dict):
+                for k, v in p.items():
+                    check(v, h.get(k, {}) if isinstance(h, dict) else h)
+            elif h == "pipe" and p.shape[0] % n:
+                raise ValueError(
+                    f"{p.shape[0]} pipelined blocks not divisible by "
+                    f"{self.pipe_axis}={n} stages"
+                )
+
+        if hints:
+            check(params, hints)
+        return super().put_params(params, hints)
+
+
 class DataSeqParallel(DataParallel):
     """Sequence (context) parallelism composed with data parallelism.
 

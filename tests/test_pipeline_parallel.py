@@ -1,0 +1,202 @@
+"""Pipeline parallelism: PipelinedBlocks + DataPipelineParallel.
+
+Beyond-reference capability (SURVEY.md §2c "Pipeline parallelism: NO"):
+the GPipe microbatch schedule must match single-device numerics exactly
+(same stacked params, scan vs schedule), shard one-stage-per-rank, and
+train end-to-end through fit/evaluate on the 8-device CPU sim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+VOCAB = 64
+
+
+def _lm(num_layers=4, **kw):
+    kw.setdefault("d_model", 32)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_len", 16)
+    return dtpu.models.transformer_lm(
+        VOCAB, num_layers=num_layers, pipeline=True, **kw
+    )
+
+
+def _copy_task(n, t, seed=0):
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, VOCAB, size=n)
+    pos = np.arange(t + 1)[None, :]
+    toks = (starts[:, None] + pos) % VOCAB
+    return toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+
+
+def _mlp_block():
+    return nn.Sequential(
+        [nn.Dense(16, activation="gelu"), nn.Dense(8)], name="main"
+    )
+
+
+class TestPipelinedBlocksLayer:
+    def test_scan_matches_unrolled(self):
+        layer = nn.PipelinedBlocks(_mlp_block, 3)
+        params, state, out = layer.init(jax.random.PRNGKey(0), (8,))
+        assert out == (8,)
+        assert state == {}
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+        y, _ = layer.apply(params, state, x)
+        # unrolled reference: apply each stage's slice in order
+        h = x
+        block = _mlp_block()
+        block.init(jax.random.PRNGKey(0), (8,))  # finalize names
+        for i in range(3):
+            p_i = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            h, _ = block.apply(p_i, {}, h)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(h),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_stage_params_differ(self):
+        layer = nn.PipelinedBlocks(_mlp_block, 2)
+        params, _, _ = layer.init(jax.random.PRNGKey(0), (8,))
+        kernel = params["blocks"]["dense"]["kernel"]
+        assert not np.allclose(kernel[0], kernel[1])  # distinct stage init
+
+    def test_shape_changing_block_rejected(self):
+        bad = lambda: nn.Dense(5)
+        with pytest.raises(ValueError, match="preserve shape"):
+            nn.PipelinedBlocks(bad, 2).init(jax.random.PRNGKey(0), (8,))
+
+    def test_stateful_block_rejected(self):
+        bad = lambda: nn.BatchNorm()
+        with pytest.raises(ValueError, match="stateless"):
+            nn.PipelinedBlocks(bad, 2).init(jax.random.PRNGKey(0), (8,))
+
+    def test_hints(self):
+        layer = nn.PipelinedBlocks(_mlp_block, 2)
+        assert layer.sharding_hints() == {"blocks": "pipe"}
+
+    def test_dtype_changing_block_carries(self):
+        # bf16-compute blocks in an f32 stream: output cast back to carry
+        # dtype, like any mixed-precision layer.
+        mk = lambda: nn.Dense(8, dtype=jnp.bfloat16)
+        layer = nn.PipelinedBlocks(mk, 2)
+        params, state, _ = layer.init(jax.random.PRNGKey(0), (8,))
+        y, _ = layer.apply(params, state, jnp.zeros((4, 8), jnp.float32))
+        assert y.dtype == jnp.float32
+
+    def test_num_microbatches_validated(self, devices):
+        with pytest.raises(ValueError, match="num_microbatches"):
+            dtpu.DataPipelineParallel(pipeline_parallel=2, num_microbatches=0)
+
+    def test_dropout_block_trains_under_pp(self, devices):
+        mk = lambda: nn.Sequential(
+            [nn.Dense(16, activation="gelu"), nn.Dropout(0.1), nn.Dense(8)],
+            name="main",
+        )
+        strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
+        with strategy.scope():
+            model = dtpu.Model(nn.Sequential(
+                [nn.PipelinedBlocks(mk, 2), nn.Dense(4)]))
+            model.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = rng.integers(0, 4, size=32).astype(np.int32)
+        hist = model.fit(x, y, batch_size=16, epochs=2, verbose=0)
+        assert all(np.isfinite(hist.history["loss"]))
+
+
+class TestDataPipelineParallel:
+    def test_param_shardings(self, devices):
+        strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
+        with strategy.scope():
+            model = dtpu.Model(_lm())
+            model.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+        model.build((16,))
+        stacked = model.params["pipelined_blocks"]["blocks"]
+        for leaf in jax.tree_util.tree_leaves(stacked):
+            assert leaf.sharding.spec[0] == "pipe", leaf.sharding
+        # non-pipelined params replicated
+        emb = model.params["embedding"]["table"]
+        assert emb.sharding.spec == PartitionSpec()
+
+    @pytest.mark.parametrize("pp,mb", [(2, 2), (4, 4)], ids=["pp2", "pp4"])
+    def test_pp_matches_single_device(self, devices, pp, mb):
+        x, y = _copy_task(64, 16, seed=3)
+
+        def train(strategy):
+            def mk():
+                m = dtpu.Model(_lm())
+                m.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=["accuracy"])
+                return m
+
+            if strategy is None:
+                model = mk()
+            else:
+                with strategy.scope():
+                    model = mk()
+            hist = model.fit(x, y, batch_size=32, epochs=2, verbose=0,
+                             seed=7, shuffle=False)
+            return hist.history["loss"]
+
+        ref = train(None)
+        pipe = train(dtpu.DataPipelineParallel(
+            pipeline_parallel=pp, num_microbatches=mb))
+        np.testing.assert_allclose(ref, pipe, rtol=2e-4, atol=2e-5)
+
+    def test_evaluate_under_pp(self, devices):
+        strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
+        with strategy.scope():
+            model = dtpu.Model(_lm())
+            model.compile(optimizer=dtpu.optim.Adam(1e-3),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=["accuracy"])
+        model.build((16,))
+        x, y = _copy_task(32, 16, seed=5)
+        ref = dtpu.Model(_lm())
+        ref.compile(optimizer=dtpu.optim.Adam(1e-3),
+                    loss="sparse_categorical_crossentropy",
+                    metrics=["accuracy"])
+        ref.build((16,))
+        want = ref.evaluate(x, y, batch_size=8, verbose=0)
+        got = model.evaluate(x, y, batch_size=8, verbose=0)
+        assert got["loss"] == pytest.approx(want["loss"], rel=1e-4)
+
+    def test_blocks_not_divisible_by_stages(self, devices):
+        strategy = dtpu.DataPipelineParallel(pipeline_parallel=4)
+        with strategy.scope():
+            model = dtpu.Model(_lm(num_layers=3))
+            model.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+        x, y = _copy_task(32, 16)
+        with pytest.raises(ValueError, match="not divisible"):
+            model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+
+    def test_batch_not_divisible_by_microbatches(self, devices):
+        strategy = dtpu.DataPipelineParallel(
+            pipeline_parallel=2, num_microbatches=3)
+        with strategy.scope():
+            model = dtpu.Model(_lm(num_layers=2))
+            model.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+        x, y = _copy_task(32, 16)
+        with pytest.raises(ValueError, match="microbatches"):
+            model.fit(x, y, batch_size=16, epochs=1, verbose=0)
+
+    def test_learns_copy_task(self, devices):
+        strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
+        with strategy.scope():
+            model = dtpu.Model(_lm())
+            model.compile(optimizer=dtpu.optim.Adam(1e-2),
+                          loss="sparse_categorical_crossentropy",
+                          metrics=["accuracy"])
+        x, y = _copy_task(256, 16)
+        hist = model.fit(x, y, batch_size=64, epochs=6, verbose=0, seed=1)
+        assert hist.history["accuracy"][-1] > 0.7, hist.history
