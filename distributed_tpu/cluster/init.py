@@ -22,6 +22,72 @@ from ..utils import logging as dlog
 from . import config as config_lib
 
 _initialized = False
+_gathered_cache = None  # explicit-coordinator spec, cached after the gather
+
+
+def _gathered_workers(coordinator: str, n: int, index: int) -> list:
+    """Real rank-ordered worker list for an explicit-coordinator init: every
+    process contributes its own address via a host-level allgather (must run
+    on ALL processes — it is a collective). Rank 0's entry keeps the
+    coordinator's service port; other ranks report port 0 (informational
+    address — jax processes run no per-worker server, unlike the reference's
+    per-worker gRPC endpoints, /root/reference/README.md:398)."""
+    from . import net
+
+    mine = coordinator if index == 0 else f"{net.my_ip()}:0"
+    if n <= 1:
+        return [mine]
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    cap = 256
+    raw = mine.encode()
+    if len(raw) > cap:
+        raise ValueError(
+            f"worker address {mine!r} exceeds {cap} bytes"
+        )
+    buf = np.zeros(cap, np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = multihost_utils.process_allgather(buf)  # (P, cap)
+    return [
+        bytes(row).rstrip(b"\x00").decode(errors="replace")
+        for row in np.asarray(gathered)
+    ]
+
+
+def _tpu_pod_spec() -> Optional[config_lib.ClusterSpec]:
+    """Spec from the TPU runtime's own pod metadata (GCE TPU-VM env),
+    giving auto-detected clusters a real worker list too."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if not hosts:
+        return None
+    index = int(
+        os.environ.get("TPU_WORKER_ID")
+        or os.environ.get("CLOUD_TPU_TASK_ID")
+        or 0
+    )
+    workers = [f"{h.strip()}:8476" for h in hosts.split(",") if h.strip()]
+    try:
+        return config_lib.ClusterSpec(workers=workers, index=index).validate()
+    except ValueError:
+        return None
+
+
+def _should_auto_init() -> bool:
+    """Pod auto-detect is the DEFAULT on TPU platforms: fire when the TPU
+    runtime's pod-slice markers are present. DTPU_AUTO_INIT=1 forces it,
+    DTPU_AUTO_INIT=0 opts out (SURVEY.md §7 item 3)."""
+    gate = os.environ.get("DTPU_AUTO_INIT")
+    if gate == "1":
+        return True
+    if gate == "0":
+        return False
+    # Multi-host markers only: a single-host slice (TPU_WORKER_HOSTNAMES
+    # with one entry, e.g. "localhost") needs no jax.distributed at all.
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    return bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def initialize(
@@ -35,26 +101,38 @@ def initialize(
     the same ordering constraint the reference enforces by requiring a fresh
     session before setting TF_CONFIG (/root/reference/README.md:316-317).
 
-    Returns the resolved ClusterSpec (a synthetic one under auto-detect).
+    Resolution order: explicit coordinator args > explicit/env spec
+    (DTPU_CONFIG/TF_CONFIG) > TPU pod auto-detect (default on pod slices) >
+    single-process. Returns the resolved ClusterSpec with a REAL worker
+    list in every path that can know one.
     """
-    global _initialized
+    global _initialized, _gathered_cache
     if coordinator is not None:
-        spec = config_lib.ClusterSpec(
-            workers=[coordinator] + [f"?:{i}" for i in range(1, num_processes or 1)],
-            index=process_id or 0,
-        )
-        if num_processes and num_processes > 1 and not _initialized:
+        n = int(num_processes or 1)
+        idx = int(process_id or 0)
+        if _gathered_cache is not None:
+            # Repeat call (e.g. two libraries both bootstrapping): the
+            # gather below is a collective and would hang if peers don't
+            # re-enter it; the first call's result answers this one.
+            return _gathered_cache
+        if n > 1 and not _initialized:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
-                num_processes=num_processes,
-                process_id=process_id,
+                num_processes=n,
+                process_id=idx,
             )
             _initialized = True
-        return spec
+        _gathered_cache = config_lib.ClusterSpec(
+            workers=_gathered_workers(coordinator, n, idx), index=idx
+        )
+        return _gathered_cache
 
     spec = config_lib.resolve(spec)
-    if spec is not None and spec.num_processes > 1:
-        if not _initialized:
+    if spec is not None:
+        # An explicit/env spec always wins — including a single-process one
+        # (debugging one worker on a pod VM must not be hijacked by
+        # auto-detect).
+        if spec.num_processes > 1 and not _initialized:
             jax.distributed.initialize(
                 coordinator_address=spec.coordinator,
                 num_processes=spec.num_processes,
@@ -68,14 +146,31 @@ def initialize(
                     f"{jax.device_count()} devices total"
                 )
         return spec
-    # Auto-detect path: on a real TPU pod slice each host sees its local chips
-    # and jax.distributed.initialize() with no args uses the TPU metadata.
-    if os.environ.get("DTPU_AUTO_INIT") == "1" and not _initialized:
-        jax.distributed.initialize()
-        _initialized = True
-    return config_lib.ClusterSpec(
-        workers=[f"localhost:0"], index=0
-    )
+    # Auto-detect path (only when nothing explicit resolved): on a TPU pod
+    # slice each host sees its local chips and jax.distributed.initialize()
+    # with no args uses the TPU metadata. This is the documented default
+    # when pod markers are present; DTPU_AUTO_INIT=0 opts out.
+    if _should_auto_init() and not _initialized:
+        try:
+            jax.distributed.initialize()
+            _initialized = True
+        except RuntimeError as e:
+            # Best-effort: jax.distributed must run before any backend use;
+            # initialize() called late in a single-host flow should degrade
+            # to local semantics, not crash the program.
+            dlog.warning(f"pod auto-init skipped: {e}")
+    pod = _tpu_pod_spec()
+    if pod is not None:
+        return pod
+    if _initialized and jax.process_count() > 1:
+        # Auto-init joined a real cluster but the runtime exposes no host
+        # list (e.g. megascale markers only): still return truthful rank/
+        # size so chief-gating works; addresses are unknowable here.
+        return config_lib.ClusterSpec(
+            workers=[f"unknown:{i}" for i in range(jax.process_count())],
+            index=jax.process_index(),
+        )
+    return config_lib.ClusterSpec(workers=["localhost:0"], index=0)
 
 
 def is_initialized() -> bool:

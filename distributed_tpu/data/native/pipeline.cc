@@ -64,6 +64,12 @@ struct DtpuPipeline {
   const uint8_t* x;
   const int32_t* y;
   int64_t n, row, batch, steps_per_pass;
+  // Per-host sharding: this producer prepares only rows
+  // [shard_index * shard_rows, (shard_index + 1) * shard_rows) of each
+  // global batch; the step/pass/permutation sequence is identical on every
+  // host (same seed), so the host slices assemble into the exact global
+  // batch an unsharded pipeline would emit.
+  int64_t shard_index, shard_count, shard_rows;
   bool shuffle;
   uint64_t seed;
   float scale;
@@ -116,10 +122,10 @@ struct DtpuPipeline {
     // Hold the shared_ptr for the whole fill: pruning may drop the map entry.
     std::shared_ptr<std::vector<int64_t>> order_sp = perm_for(pass);
     const std::vector<int64_t>& order = *order_sp;
-    const int64_t start = within * batch;
-    slot.x.resize((size_t)(batch * row));
-    slot.y.resize((size_t)batch);
-    for (int64_t b = 0; b < batch; ++b) {
+    const int64_t start = within * batch + shard_index * shard_rows;
+    slot.x.resize((size_t)(shard_rows * row));
+    slot.y.resize((size_t)shard_rows);
+    for (int64_t b = 0; b < shard_rows; ++b) {
       const int64_t src = order[start + b];
       const uint8_t* in = x + src * row;
       float* out = slot.x.data() + b * row;
@@ -159,14 +165,22 @@ DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
                                    int64_t n, int64_t row_elems,
                                    int64_t batch, int shuffle, uint64_t seed,
                                    int depth, int threads, float scale,
-                                   int64_t start_step) {
+                                   int64_t start_step, int64_t shard_index,
+                                   int64_t shard_count) {
   if (n <= 0 || batch <= 0 || batch > n || row_elems <= 0) return nullptr;
+  if (shard_count < 1 || shard_index < 0 || shard_index >= shard_count ||
+      batch % shard_count != 0) {
+    return nullptr;
+  }
   auto* p = new DtpuPipeline();
   p->x = x;
   p->y = y;
   p->n = n;
   p->row = row_elems;
   p->batch = batch;
+  p->shard_index = shard_index;
+  p->shard_count = shard_count;
+  p->shard_rows = batch / shard_count;
   p->steps_per_pass = n / batch;
   p->shuffle = shuffle != 0;
   p->seed = seed;
@@ -187,8 +201,8 @@ DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
 }
 
 // Copies the next batch (in deterministic step order) into caller buffers of
-// shape [batch, row_elems] float32 and [batch] int32. Returns the 0-based
-// step index, or -1 if the pipeline is stopped.
+// shape [batch / shard_count, row_elems] float32 and [batch / shard_count]
+// int32. Returns the 0-based step index, or -1 if the pipeline is stopped.
 int64_t dtpu_pipeline_next(DtpuPipeline* p, float* x_out, int32_t* y_out) {
   Slot* slot;
   int64_t step;
@@ -201,9 +215,11 @@ int64_t dtpu_pipeline_next(DtpuPipeline* p, float* x_out, int32_t* y_out) {
     });
     if (p->stop) return -1;
   }
-  std::memcpy(x_out, slot->x.data(), sizeof(float) * (size_t)(p->batch * p->row));
+  std::memcpy(x_out, slot->x.data(),
+              sizeof(float) * (size_t)(p->shard_rows * p->row));
   if (y_out) {
-    std::memcpy(y_out, slot->y.data(), sizeof(int32_t) * (size_t)p->batch);
+    std::memcpy(y_out, slot->y.data(),
+                sizeof(int32_t) * (size_t)p->shard_rows);
   }
   {
     std::lock_guard<std::mutex> lock(p->mu);

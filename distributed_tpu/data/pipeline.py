@@ -93,6 +93,8 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_int,     # threads
             ctypes.c_float,   # scale
             ctypes.c_int64,   # start_step
+            ctypes.c_int64,   # shard_index
+            ctypes.c_int64,   # shard_count
         ]
         lib.dtpu_pipeline_next.restype = ctypes.c_int64
         lib.dtpu_pipeline_next.argtypes = [
@@ -123,6 +125,15 @@ class Pipeline:
       prefetch: ring depth — how many batches may be ready ahead.
       num_threads: native producer threads.
       use_native: force (True/False) or auto (None).
+      shard: optional ``(index, count)`` per-host input sharding: this
+        pipeline prepares only rows ``[index * b/count, (index+1) * b/count)``
+        of each global batch (``batch_size`` stays the GLOBAL batch). Every
+        host runs the same (seed, pass, step) sequence, so the host slices
+        assemble into exactly the batch an unsharded pipeline would emit —
+        global-batch semantics unchanged, per-host memory and prep work
+        divided by ``count`` (SURVEY.md §7 hard parts; contrast the
+        reference's full-dataset-everywhere feeding,
+        /root/reference/README.md:369-373).
 
     The stream is infinite (passes repeat, reshuffled); ``steps_per_pass``
     tells one epoch's length, matching ``fit(steps_per_epoch=...)``.
@@ -140,6 +151,7 @@ class Pipeline:
         prefetch: int = 4,
         num_threads: int = 2,
         use_native: Optional[bool] = None,
+        shard: Optional[Tuple[int, int]] = None,
     ):
         x = np.ascontiguousarray(x)
         if x.dtype != np.uint8:
@@ -155,13 +167,26 @@ class Pipeline:
         if self._y is not None and len(self._y) != len(x):
             raise ValueError("x and y lengths differ")
         self.batch_size = int(batch_size)
+        if shard is None:
+            shard = (0, 1)
+        index, count = (int(shard[0]), int(shard[1]))
+        if count < 1 or not (0 <= index < count):
+            raise ValueError(f"shard index {index} not in [0, {count})")
+        if self.batch_size % count:
+            raise ValueError(
+                f"batch_size {self.batch_size} not divisible by "
+                f"shard count {count}"
+            )
+        self.shard = (index, count) if count > 1 else None
+        self.shard_rows = self.batch_size // count
         self.shuffle = bool(shuffle)
         self.seed = int(seed)
         self.scale = float(scale)
         self.prefetch = max(1, int(prefetch))
         self.num_threads = max(1, int(num_threads))
         self.steps_per_pass = x.shape[0] // self.batch_size
-        self.batch_shape = (self.batch_size,) + x.shape[1:]
+        # Emitted (local) shape; batch_size stays the global batch.
+        self.batch_shape = (self.shard_rows,) + x.shape[1:]
         self._row = int(np.prod(x.shape[1:], dtype=np.int64))
 
         lib = _load_native() if use_native in (None, True) else None
@@ -189,6 +214,8 @@ class Pipeline:
             self.num_threads,
             self.scale,
             start_step,
+            0 if self.shard is None else self.shard[0],
+            1 if self.shard is None else self.shard[1],
         )
         if not handle:
             raise RuntimeError("dtpu_pipeline_create failed")
@@ -221,7 +248,7 @@ class Pipeline:
         if self._closed:
             raise ValueError("Pipeline is closed")
         xb = np.empty(self.batch_shape, np.float32)
-        yb = np.empty((self.batch_size,), np.int32)
+        yb = np.empty((self.shard_rows,), np.int32)
         if self._handle is not None:
             step = self._lib.dtpu_pipeline_next(
                 self._handle,
@@ -247,7 +274,10 @@ class Pipeline:
                 else np.arange(self._x.shape[0])
             )
             self._perm_cache = (pass_idx, order)
-        idx = order[within * self.batch_size : (within + 1) * self.batch_size]
+        start = within * self.batch_size
+        if self.shard is not None:
+            start += self.shard[0] * self.shard_rows
+        idx = order[start : start + self.shard_rows]
         xb[:] = self._x[idx].astype(np.float32) * self.scale
         if self._y is not None:
             yb[:] = self._y[idx]

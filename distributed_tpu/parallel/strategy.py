@@ -88,8 +88,18 @@ class Strategy:
         """Optimizer state placed consistently with the params."""
         return self.put_params(tx.init(params))
 
-    def put_batch(self, batch):
-        """Place a host-global numpy batch onto devices."""
+    def put_batch(self, batch, per_host: bool = False):
+        """Place a numpy batch onto devices. ``per_host=True`` means each
+        process passes only ITS row-shard of the global batch (from e.g. a
+        sharded ``data.Pipeline``); the shards assemble into one global
+        array. Default is host-global input (every process passes the full
+        batch, the reference's feeding model)."""
+        if per_host:
+            raise ValueError(
+                f"{type(self).__name__} cannot assemble per-host input "
+                "shards; use an unsharded data source, or a strategy with "
+                "a batch axis (DataParallel family)"
+            )
         return batch
 
     def local_batch_size(self, global_batch: int) -> int:
@@ -103,7 +113,14 @@ class SingleDevice(Strategy):
     def __init__(self, device: Optional[jax.Device] = None):
         self.device = device or jax.devices()[0]
 
-    def put_batch(self, batch):
+    def put_batch(self, batch, per_host: bool = False):
+        if per_host:
+            raise ValueError(
+                "SingleDevice cannot assemble per-host input shards; a "
+                "sharded data.Pipeline would silently train on a fraction "
+                "of each batch. Use shard=None, or build the model under a "
+                "DataParallel-family strategy scope"
+            )
         return jax.device_put(batch, self.device)
 
     def put_params(self, params, hints=None):
@@ -146,13 +163,22 @@ class DataParallel(Strategy):
         rep = NamedSharding(self.mesh, PartitionSpec())
         return jax.device_put(params, rep)
 
-    def put_batch(self, batch):
-        """Place a *global* batch (same on every process, like the reference's
-        full-dataset-everywhere feeding, /root/reference/README.md:369-373):
-        multi-host, each process keeps only its contiguous row-slice and the
-        slices assemble into one global sharded array (per-host input
-        sharding, SURVEY.md §7 hard parts)."""
+    def put_batch(self, batch, per_host: bool = False):
+        """Place a batch. Host-global by default (same array on every
+        process, like the reference's full-dataset-everywhere feeding,
+        /root/reference/README.md:369-373, with each process device-putting
+        only its addressable slices). ``per_host=True``: each process passes
+        only its own row-shard (rows [i*b/P, (i+1)*b/P) of the global batch,
+        e.g. from ``data.Pipeline(shard=(i, P))``) and never materializes
+        the rest (SURVEY.md §7 hard parts)."""
         sh = self.batch_sharding()
+        if per_host:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sh, np.asarray(x)
+                ),
+                batch,
+            )
         return jax.tree_util.tree_map(lambda x: _put_global(x, sh), batch)
 
     def local_batch_size(self, global_batch: int) -> int:
@@ -467,7 +493,7 @@ class DataSeqParallel(DataParallel):
         # Rank-dependent: applied per-leaf in put_batch.
         return NamedSharding(self.mesh, PartitionSpec(self.axis, self.seq_axis))
 
-    def put_batch(self, batch):
+    def put_batch(self, batch, per_host: bool = False):
         def _put(x):
             x = np.asarray(x)
             if x.ndim >= 2:
@@ -483,9 +509,33 @@ class DataSeqParallel(DataParallel):
                 )
             else:
                 spec = PartitionSpec(self.axis)
-            return _put_global(x, NamedSharding(self.mesh, spec))
+            sh = NamedSharding(self.mesh, spec)
+            if per_host:
+                # Each process holds its row-shard with the FULL sequence
+                # length. That only maps onto the process's addressable
+                # shards when no seq split crosses a process boundary.
+                if x.ndim >= 2 and self._seq_spans_processes():
+                    raise ValueError(
+                        "per-host sharded input is unsupported when the "
+                        f"'{self.seq_axis}' axis spans processes: each "
+                        "process would also need to pre-slice its sequence "
+                        "shard. Feed host-global batches instead"
+                    )
+                return jax.make_array_from_process_local_data(sh, x)
+            return _put_global(x, sh)
 
         return jax.tree_util.tree_map(_put, batch)
+
+    def _seq_spans_processes(self) -> bool:
+        """True when devices along the seq mesh axis belong to more than
+        one process (so a per-host row-shard can't carry full seq rows)."""
+        devs = self.mesh.devices
+        seq_dim = self.mesh.axis_names.index(self.seq_axis)
+        moved = np.moveaxis(devs, seq_dim, -1).reshape(-1, devs.shape[seq_dim])
+        for line in moved:
+            if len({d.process_index for d in line}) > 1:
+                return True
+        return False
 
 
 # Alias keeping the reference's class name greppable for migrating users.

@@ -272,6 +272,9 @@ class Model:
                 )
             source = x
             batch_size = getattr(source, "batch_size", batch_size)
+            # A per-host-sharded source (data.Pipeline(shard=(i, P))) emits
+            # only this process's rows; placement assembles the global batch.
+            per_host = getattr(source, "shard", None) is not None
             if steps_per_epoch is None:
                 steps_per_epoch = getattr(source, "steps_per_pass", None)
                 if steps_per_epoch is None:
@@ -291,6 +294,7 @@ class Model:
                 return next(source)
 
         else:
+            per_host = False
             x = np.asarray(x)
             y = np.asarray(y)
             if not self.built:
@@ -360,7 +364,9 @@ class Model:
             resume_offset = 0
             for _ in range(epoch_steps):
                 xb, yb = next_batch()
-                batch = self.strategy.put_batch({"x": xb, "y": yb})
+                batch = self.strategy.put_batch(
+                    {"x": xb, "y": yb}, per_host=per_host
+                )
                 rng = self._step_rng()
                 self.params, self.state, self.opt_state, loss, mvals = step_fn(
                     self.params, self.state, self.opt_state,

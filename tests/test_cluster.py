@@ -64,3 +64,65 @@ def test_net_helpers():
     # Unresolvable hostname -> False (sandboxed networks may report plain
     # refusal for unroutable IPs, which counts as host-up by design).
     assert net.check_reachable("no-such-host.invalid:1", timeout=0.5) is False
+
+
+class TestResolutionOrder:
+    """SURVEY.md §7 item 3: explicit > env > pod auto-detect > single-process."""
+
+    def test_explicit_beats_env(self, monkeypatch):
+        from distributed_tpu.cluster import config as cfg
+        monkeypatch.setenv(cfg.ENV_VAR, json.dumps(
+            {"cluster": {"worker": ["env:1"]}, "task": {"index": 0}}))
+        explicit = ClusterSpec(workers=["explicit:1"], index=0)
+        assert cfg.resolve(explicit).workers == ["explicit:1"]
+
+    def test_env_beats_auto(self, monkeypatch):
+        from distributed_tpu.cluster import config as cfg
+        monkeypatch.setenv(cfg.ENV_VAR, json.dumps(
+            {"cluster": {"worker": ["env:1"]}, "task": {"index": 0}}))
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "pod-a,pod-b")
+        assert cfg.resolve(None).workers == ["env:1"]
+
+    def test_auto_gate_default_on_pod_markers(self, monkeypatch):
+        from distributed_tpu.cluster import init as init_mod
+        monkeypatch.delenv("DTPU_AUTO_INIT", raising=False)
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+        assert not init_mod._should_auto_init()
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+        assert not init_mod._should_auto_init()  # single-host slice: no-op
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "pod-a,pod-b")
+        assert init_mod._should_auto_init()  # default ON when multi-host
+        monkeypatch.setenv("DTPU_AUTO_INIT", "0")
+        assert not init_mod._should_auto_init()  # explicit opt-out wins
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.setenv("DTPU_AUTO_INIT", "1")
+        assert init_mod._should_auto_init()  # forced on without markers
+
+    def test_tpu_pod_spec_real_worker_list(self, monkeypatch):
+        from distributed_tpu.cluster import init as init_mod
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "pod-a,pod-b,pod-c")
+        monkeypatch.setenv("TPU_WORKER_ID", "2")
+        spec = init_mod._tpu_pod_spec()
+        assert spec.workers == ["pod-a:8476", "pod-b:8476", "pod-c:8476"]
+        assert spec.index == 2 and not spec.is_chief
+
+    def test_tpu_pod_spec_absent(self, monkeypatch):
+        from distributed_tpu.cluster import init as init_mod
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        assert init_mod._tpu_pod_spec() is None
+
+    def test_single_process_default(self, monkeypatch):
+        from distributed_tpu import cluster
+        for var in ("DTPU_CONFIG", "TF_CONFIG", "TPU_WORKER_HOSTNAMES",
+                    "DTPU_AUTO_INIT", "MEGASCALE_COORDINATOR_ADDRESS"):
+            monkeypatch.delenv(var, raising=False)
+        spec = cluster.initialize()
+        assert spec.num_processes == 1 and spec.is_chief
+
+    def test_explicit_coordinator_single_process_real_list(self):
+        from distributed_tpu import cluster
+        spec = cluster.initialize(coordinator="10.1.2.3:9999",
+                                  num_processes=1, process_id=0)
+        assert spec.workers == ["10.1.2.3:9999"]  # no "?:i" placeholders
+        assert spec.index == 0

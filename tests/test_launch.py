@@ -131,3 +131,41 @@ def test_distributed_training_via_launcher(tmp_path):
     accs = {r.value["acc"] for r in results}
     losses = {r.value["loss"] for r in results}
     assert len(accs) == 1 and len(losses) == 1  # replicas in lockstep
+
+
+@pytest.mark.slow
+def test_explicit_coordinator_gathers_real_worker_list(tmp_path):
+    """initialize(coordinator=...) must return a REAL rank-ordered worker
+    list on every process (gathered collectively), not placeholders."""
+    script = write_worker(
+        tmp_path,
+        """
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import distributed_tpu as dtpu
+        from distributed_tpu.cluster import from_env
+        from distributed_tpu.launch import report_result
+
+        env_spec = from_env()
+        spec = dtpu.cluster.initialize(
+            coordinator=env_spec.coordinator,
+            num_processes=env_spec.num_processes,
+            process_id=env_spec.index,
+        )
+        report_result({"rank": spec.index, "workers": spec.workers})
+        """,
+    )
+    results = LocalLauncher().run([sys.executable, script], 2, timeout=120)
+    assert all(r.ok for r in results), [
+        (r.index, r.error, r.log_tail[-500:]) for r in results
+    ]
+    for r in results:
+        workers = r.value["workers"]
+        assert len(workers) == 2
+        assert not any(w.startswith("?") for w in workers)
+        host0 = workers[0].rsplit(":", 1)[0]
+        assert host0 not in ("", "?")
+    # identical list on both ranks (collective gather)
+    assert results[0].value["workers"] == results[1].value["workers"]

@@ -1,0 +1,109 @@
+"""SSHLauncher behavior without real hosts: a fake-ssh shim runs the
+"remote" command locally with bash, exercising the production multi-host
+path — stdout result framing, peer-failure gang kill, timeout labeling,
+and config injection (the reference's per-machine manual sessions,
+/root/reference/README.md:82-114, automated)."""
+
+import json
+import os
+import stat
+import sys
+import time
+
+import pytest
+
+from distributed_tpu.cluster import config as config_lib
+from distributed_tpu.launch.core import SSHLauncher, STDOUT_MARK
+
+
+@pytest.fixture()
+def fake_ssh(tmp_path):
+    """An ssh stand-in: drops the host argument, runs the command locally."""
+    path = tmp_path / "fake-ssh"
+    path.write_text('#!/bin/sh\nshift\nexec bash -c "$1"\n')
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+def _worker_script(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(body)
+    return str(script)
+
+
+def test_result_framing_and_config_injection(tmp_path, fake_ssh):
+    script = _worker_script(
+        tmp_path,
+        "import os, json\n"
+        "from distributed_tpu.cluster import from_env\n"
+        "from distributed_tpu.launch import report_result\n"
+        "spec = from_env()\n"
+        # noise around the frame must not confuse the parser
+        "print('log line before')\n"
+        "report_result({'rank': spec.index, 'peers': spec.workers})\n"
+        "print('log line after')\n",
+    )
+    hosts = ["127.0.0.1", "127.0.0.1"]
+    launcher = SSHLauncher(hosts, ssh_cmd=fake_ssh)
+    results = launcher.run(
+        [sys.executable, script], timeout=60,
+        env_extra={"PYTHONPATH": os.pathsep.join(sys.path)},
+    )
+    assert [r.index for r in results] == [0, 1]
+    assert all(r.ok for r in results), results
+    assert sorted(r.value["rank"] for r in results) == [0, 1]
+    peer_lists = {tuple(r.value["peers"]) for r in results}
+    assert len(peer_lists) == 1  # same rank-ordered list everywhere
+    assert all(len(r.value["peers"]) == 2 for r in results)
+
+
+def test_malformed_frame_is_ignored(tmp_path, fake_ssh):
+    script = _worker_script(
+        tmp_path,
+        f"print({STDOUT_MARK!r} + 'not json')\n",
+    )
+    launcher = SSHLauncher(["127.0.0.1"], ssh_cmd=fake_ssh)
+    results = launcher.run([sys.executable, script], timeout=60)
+    assert results[0].ok
+    assert results[0].value is None
+
+
+def test_peer_failure_gang_kill(tmp_path, fake_ssh):
+    script = _worker_script(
+        tmp_path,
+        "import os, sys, time, json\n"
+        "spec = json.loads(os.environ['DTPU_CONFIG'])\n"
+        "if spec['task']['index'] == 1:\n"
+        "    sys.exit(3)\n"
+        "time.sleep(300)\n",
+    )
+    launcher = SSHLauncher(["127.0.0.1", "127.0.0.1"], ssh_cmd=fake_ssh)
+    t0 = time.time()
+    results = launcher.run([sys.executable, script], timeout=240, grace=2)
+    elapsed = time.time() - t0
+    assert elapsed < 60, "gang kill must not wait out the timeout"
+    by_rank = {r.index: r for r in results}
+    assert not by_rank[1].ok and "exit code 3" in by_rank[1].error
+    assert not by_rank[0].ok
+    assert "peer failure" in by_rank[0].error
+    # the killed worker's log is preserved for debugging
+    assert by_rank[0].exit_code != 0
+
+
+def test_timeout_labeling(tmp_path, fake_ssh):
+    script = _worker_script(tmp_path, "import time\ntime.sleep(300)\n")
+    launcher = SSHLauncher(["127.0.0.1"], ssh_cmd=fake_ssh)
+    t0 = time.time()
+    results = launcher.run([sys.executable, script], timeout=3, grace=2)
+    assert time.time() - t0 < 60
+    assert not results[0].ok
+    assert results[0].error == "timeout"
+
+
+def test_preflight_failure_raises(fake_ssh):
+    # An unresolvable host must fail fast, before any spawn.
+    launcher = SSHLauncher(
+        ["definitely-not-a-real-host.invalid"], ssh_cmd=fake_ssh
+    )
+    with pytest.raises(RuntimeError, match="Preflight"):
+        launcher.run([sys.executable, "-c", "pass"], timeout=10)
