@@ -1,38 +1,108 @@
-"""Benchmark: MNIST CNN training steps/sec on TPU.
+"""Benchmarks: MNIST CNN (headline, vs-reference), ResNet-50, transformer LM.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "steps/s", "vs_baseline": N,
+   "extra": [{resnet-50 ...}, {transformer-lm ...}]}
 
-Baseline: the reference's steady-state distributed rate — epochs 2-3 take ~9s
-for 5 steps at global batch 256 on the 4-worker gRPC CollectiveAllReduce setup
-(/root/reference/README.md:413-414, BASELINE.md) => 0.556 steps/s. The
-north-star target is >=4x that (BASELINE.json).
+Headline baseline: the reference's steady-state distributed rate — epochs 2-3
+take ~9s for 5 steps at global batch 256 on the 4-worker gRPC
+CollectiveAllReduce setup (/root/reference/README.md:413-414, BASELINE.md)
+=> 0.556 steps/s. The north-star target is >=4x that (BASELINE.json).
 
-Method: the same global-batch-256 train step (forward + backward + SGD update
-+ metrics, exactly what fit() runs), steady-state: pre-staged device batches,
-warmup for compile, then timed steps with a final block. Runs on whatever
-devices are available (1 real chip here; a DP mesh if several).
+The reference publishes no model larger than the 347k-param MNIST CNN
+(SURVEY.md §6), where a TPU step is dispatch-bound. The extra modes measure
+the framework at scale on the real chip:
+
+- resnet50: synthetic ImageNet (224x224), global batch 256, bf16 compute —
+  BASELINE.json configs[3]'s model. Reports steps/s, achieved TFLOP/s, MFU.
+- transformer_lm: ~136M-param GPT-2-small-shaped LM (untied head), 32k vocab, seq 1024,
+  Pallas fused cross-entropy on the LM head. Reports steps/s, TFLOP/s, MFU.
+
+MFU = achieved matmul TFLOP/s / the chip's peak bf16 TFLOP/s (null when the
+device kind is unknown, e.g. CPU smoke runs). FLOP counts are the standard
+analytic ones (3x forward for training; 6ND + attention for the LM), not
+XLA's cost model.
+
+Each mode is a function with size parameters so tests/test_bench.py can
+smoke-run the exact code path on CPU with tiny shapes.
 """
 
 import json
+import sys
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 import distributed_tpu as dtpu
 
 BASELINE_STEPS_PER_SEC = 5.0 / 9.0  # README.md:413-414
 GLOBAL_BATCH = 256  # reference's 4-worker global batch (README.md:366-367)
-WARMUP, MEASURE = 10, 100
+
+# Peak dense bf16 TFLOP/s per chip, by device_kind substring (public specs).
+_PEAK_TFLOPS = {
+    "v6": 918.0,  # Trillium
+    "v5p": 459.0,
+    "v5e": 197.0,
+    "v5 lite": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
 
 
-def main():
-    n_dev = len(jax.devices())
-    if n_dev > 1:
-        strategy = dtpu.DataParallel()
-    else:
-        strategy = dtpu.SingleDevice()
+def _peak_tflops():
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def _mfu(tflops_achieved):
+    peak = _peak_tflops()
+    if peak is None or tflops_achieved is None:
+        return None
+    return round(tflops_achieved / peak, 4)
+
+
+def _strategy():
+    return dtpu.DataParallel() if len(jax.devices()) > 1 else dtpu.SingleDevice()
+
+
+def _sync(value):
+    # jax.block_until_ready is a no-op on some remote-device transports
+    # (observed on the tunneled 'axon' TPU platform: timing a matmul chain
+    # with block_until_ready reported >1000x the chip's peak FLOP/s). A host
+    # fetch of the value is an unambiguous barrier everywhere.
+    np.asarray(jax.device_get(value))
+
+
+def _time_steps(model, batch, warmup, measure):
+    """Steady-state steps/s of the compiled train step on pre-staged data."""
+    step_fn = model._get_train_step()
+    rng = jax.random.PRNGKey(0)
+    params, state, opt = model.params, model.state, model.opt_state
+    loss = None
+    for _ in range(warmup):
+        params, state, opt, loss, _ = step_fn(
+            params, state, opt, batch["x"], batch["y"], rng
+        )
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        params, state, opt, loss, _ = step_fn(
+            params, state, opt, batch["x"], batch["y"], rng
+        )
+    _sync(loss)
+    return measure / (time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------- headline --
+def bench_mnist(global_batch=GLOBAL_BATCH, warmup=10, measure=100):
+    """The reference workload: 347k-param CNN, global batch 256."""
+    strategy = _strategy()
     with strategy.scope():
         model = dtpu.Model(dtpu.models.mnist_cnn())
         model.compile(
@@ -42,44 +112,138 @@ def main():
         )
     model.build((28, 28, 1))
 
-    x, y = dtpu.data.synthetic_images(GLOBAL_BATCH * 4, (28, 28), 10, 0)
-    x = x[..., None].astype(np.float32) / 255.0
-    y = y.astype(np.int32)
-    batches = [
-        model.strategy.put_batch(
-            {"x": x[i * GLOBAL_BATCH : (i + 1) * GLOBAL_BATCH],
-             "y": y[i * GLOBAL_BATCH : (i + 1) * GLOBAL_BATCH]}
-        )
-        for i in range(4)
-    ]
-
-    step_fn = model._get_train_step()
-    rng = jax.random.PRNGKey(0)
-    params, state, opt = model.params, model.state, model.opt_state
-    for i in range(WARMUP):
-        b = batches[i % 4]
-        params, state, opt, loss, _ = step_fn(params, state, opt, b["x"], b["y"], rng)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for i in range(MEASURE):
-        b = batches[i % 4]
-        params, state, opt, loss, _ = step_fn(params, state, opt, b["x"], b["y"], rng)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-
-    steps_per_sec = MEASURE / dt
-    print(
-        json.dumps(
-            {
-                "metric": "mnist_cnn_train_steps_per_sec_gb256",
-                "value": round(steps_per_sec, 2),
-                "unit": "steps/s",
-                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 1),
-            }
-        )
+    x, y = dtpu.data.synthetic_images(global_batch, (28, 28), 10, 0)
+    batch = model.strategy.put_batch(
+        {"x": x[..., None].astype(np.float32) / 255.0, "y": y.astype(np.int32)}
     )
+    steps_per_sec = _time_steps(model, batch, warmup, measure)
+    return {
+        "metric": "mnist_cnn_train_steps_per_sec_gb256",
+        "value": round(steps_per_sec, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 1),
+    }
+
+
+# ---------------------------------------------------------------- resnet50 --
+def bench_resnet50(global_batch=256, image_size=224, warmup=3, measure=20,
+                   num_classes=1000, depth=50):
+    """ResNet-50 ImageNet training step (BASELINE.json configs[3]), bf16."""
+    strategy = _strategy()
+    with strategy.scope():
+        model = dtpu.Model(
+            dtpu.models.resnet(depth, num_classes, dtype=jnp.bfloat16)
+        )
+        model.compile(
+            optimizer=dtpu.optim.SGD(0.1, momentum=0.9),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+    model.build((image_size, image_size, 3))
+
+    rng = np.random.default_rng(0)
+    batch = model.strategy.put_batch({
+        "x": rng.standard_normal(
+            (global_batch, image_size, image_size, 3), dtype=np.float32
+        ),
+        "y": rng.integers(0, num_classes, (global_batch,), dtype=np.int64)
+            .astype(np.int32),
+    })
+    steps_per_sec = _time_steps(model, batch, warmup, measure)
+
+    # Forward FLOPs: ~4.089 GFLOP per 224x224 image for ResNet-50 (the
+    # standard published count, 2x MACs); scale quadratically for other
+    # resolutions, linearly-ish for other depths via a conv-count ratio.
+    if depth == 50:
+        fwd_per_image = 4.089e9 * (image_size / 224.0) ** 2
+    else:
+        fwd_per_image = None
+    out = {
+        "metric": f"resnet{depth}_train_steps_per_sec_gb{global_batch}",
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/s",
+        "images_per_sec": round(steps_per_sec * global_batch, 1),
+    }
+    if fwd_per_image is not None:
+        tflops = steps_per_sec * 3.0 * fwd_per_image * global_batch / 1e12
+        out["tflops"] = round(tflops, 4)
+        out["mfu"] = _mfu(tflops)
+    return out
+
+
+# ---------------------------------------------------------- transformer LM --
+def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
+                         d_model=768, num_heads=12, warmup=3, measure=20):
+    """~136M-param LM (GPT-2-small shape, untied head), Pallas fused xent on
+    the 32k-vocab head."""
+    strategy = _strategy()
+    with strategy.scope():
+        model = dtpu.Model(
+            dtpu.models.transformer_lm(
+                vocab, num_layers=num_layers, d_model=d_model,
+                num_heads=num_heads, max_len=seq_len, dtype=jnp.bfloat16,
+            )
+        )
+        model.compile(
+            optimizer=dtpu.optim.Adam(1e-4),
+            loss="pallas_sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
+    model.build((seq_len,))
+    dev_batch = model.strategy.put_batch({
+        "x": tok[:, :-1].astype(np.int32),
+        "y": tok[:, 1:].astype(np.int32),
+    })
+    steps_per_sec = _time_steps(model, dev_batch, warmup, measure)
+
+    n_params = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params)
+    )
+    tokens = batch * seq_len
+    d_ff = 4 * d_model
+    # Analytic matmul FLOPs per token, forward: per block qkv+proj (8 d^2) +
+    # MLP (2 d d_ff * 2) + attention scores/values (4 s d); LM head (2 d V).
+    fwd_per_token = (
+        num_layers * (8 * d_model**2 + 4 * d_model * d_ff
+                      + 4 * seq_len * d_model)
+        + 2 * d_model * vocab
+    )
+    tflops = steps_per_sec * 3.0 * fwd_per_token * tokens / 1e12
+    return {
+        "metric": f"transformer_lm_{n_params//1_000_000}M_train_steps_per_sec",
+        "value": round(steps_per_sec, 3),
+        "unit": "steps/s",
+        "tokens_per_sec": round(steps_per_sec * tokens, 1),
+        "params": n_params,
+        "seq_len": seq_len,
+        "vocab": vocab,
+        "tflops": round(tflops, 4),
+        "mfu": _mfu(tflops),
+    }
+
+
+def main(modes=("mnist", "resnet50", "lm")):
+    known = {"mnist", "resnet50", "lm"}
+    unknown = set(modes) - known
+    if unknown or not modes:
+        raise SystemExit(
+            f"unknown bench mode(s) {sorted(unknown)}; choose from {sorted(known)}"
+        )
+    headline = bench_mnist() if "mnist" in modes else None
+    extra = []
+    if "resnet50" in modes:
+        extra.append(bench_resnet50())
+    if "lm" in modes:
+        extra.append(bench_transformer_lm())
+    result = headline or extra.pop(0)
+    if extra:
+        result["extra"] = extra
+    result["device"] = jax.devices()[0].device_kind
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    main(tuple(sys.argv[1:]) or ("mnist", "resnet50", "lm"))

@@ -58,13 +58,20 @@ class ClusterSpec:
     @classmethod
     def from_json(cls, text: str) -> "ClusterSpec":
         obj = json.loads(text)
-        workers = obj["cluster"]["worker"]
         task = obj.get("task", {})
         if task.get("type", "worker") != "worker":
             raise ValueError(
                 f"Only 'worker' tasks exist (got {task.get('type')!r}); the "
                 "reference likewise has no parameter servers (SURVEY.md §2c)"
             )
+        cluster = obj.get("cluster", {})
+        if "worker" not in cluster:
+            raise ValueError(
+                f"Cluster spec must contain a 'worker' job (got jobs "
+                f"{sorted(cluster)}); parameter-server / evaluator jobs are "
+                "not supported"
+            )
+        workers = cluster["worker"]
         return cls(workers=list(workers), index=int(task.get("index", 0)))
 
     def validate(self):

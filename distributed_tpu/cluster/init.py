@@ -159,13 +159,26 @@ def initialize(
             # initialize() called late in a single-host flow should degrade
             # to local semantics, not crash the program.
             dlog.warning(f"pod auto-init skipped: {e}")
-    pod = _tpu_pod_spec()
-    if pod is not None:
-        return pod
-    if _initialized and jax.process_count() > 1:
-        # Auto-init joined a real cluster but the runtime exposes no host
-        # list (e.g. megascale markers only): still return truthful rank/
-        # size so chief-gating works; addresses are unknowable here.
+    if jax.process_count() > 1:
+        # Multi-process for real — whether our auto-init did it or the user
+        # called jax.distributed.initialize() themselves. The returned spec
+        # must agree with the actual runtime: only adopt the pod metadata's
+        # worker list when it matches what jax.distributed really formed.
+        # Conversely, when auto-init was opted out (DTPU_AUTO_INIT=0) or
+        # failed and the runtime stayed single-process, pod env markers may
+        # still be present — returning them would disable chief-gating on a
+        # process that is in fact the only one (the single-process fall-
+        # through below handles that case).
+        pod = _tpu_pod_spec()
+        if (
+            pod is not None
+            and pod.num_processes == jax.process_count()
+            and pod.index == jax.process_index()
+        ):
+            return pod
+        # Joined a real cluster but the runtime exposes no (consistent)
+        # host list: still return truthful rank/size so chief-gating
+        # works; addresses are unknowable here.
         return config_lib.ClusterSpec(
             workers=[f"unknown:{i}" for i in range(jax.process_count())],
             index=jax.process_index(),

@@ -35,10 +35,24 @@ _REGISTRY = {"sgd": SGD, "adam": Adam, "adamw": AdamW}
 
 
 def get(name_or_tx, **kwargs):
-    """Resolve 'sgd'/'adam'/'adamw' by name, or pass an optax transform through."""
+    """Resolve 'sgd'/'adam'/'adamw' by name, or pass an optax transform through.
+
+    A (init_fn, update_fn) sequence is rebuilt into a GradientTransformation:
+    ``GradientTransformation`` is a NamedTuple, and language bridges flatten
+    NamedTuples to plain lists (reticulate converts Python tuples to R lists,
+    so an optimizer built in R via ``dtpu()$optim$get(...)`` comes back as a
+    list of its two functions — caught by tests/test_reticulate_semantics.py).
+    """
     if isinstance(name_or_tx, str):
         try:
             return _REGISTRY[name_or_tx.lower()](**kwargs)
         except KeyError:
             raise ValueError(f"Unknown optimizer {name_or_tx!r}") from None
+    if (
+        isinstance(name_or_tx, (list, tuple))
+        and not isinstance(name_or_tx, optax.GradientTransformation)
+        and len(name_or_tx) == 2
+        and all(callable(f) for f in name_or_tx)
+    ):
+        return optax.GradientTransformation(*name_or_tx)
     return name_or_tx

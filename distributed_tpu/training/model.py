@@ -274,7 +274,10 @@ class Model:
             batch_size = getattr(source, "batch_size", batch_size)
             # A per-host-sharded source (data.Pipeline(shard=(i, P))) emits
             # only this process's rows; placement assembles the global batch.
-            per_host = getattr(source, "shard", None) is not None
+            # Specifically a (process_index, process_count) tuple, the shape
+            # data.Pipeline(shard=...) sets — NOT any `shard` attribute (a
+            # tf.data-style .shard() method must not trigger per-host mode).
+            per_host = isinstance(getattr(source, "shard", None), tuple)
             if steps_per_epoch is None:
                 steps_per_epoch = getattr(source, "steps_per_pass", None)
                 if steps_per_epoch is None:

@@ -1,0 +1,582 @@
+"""Reticulate-semantics simulation for the R binding (VERDICT round 1 #3).
+
+No R interpreter exists in this image, so the `r/distributedtpu` package
+can't execute. This module drives `distributed_tpu` from Python *through the
+exact value conversions reticulate applies* at the R<->Python boundary, so
+every `dtpu()$...` call site in `r/distributedtpu/R/*.R` runs against the
+real Python package with R-marshaled inputs and outputs.
+
+Reticulate conversion rules simulated (convert = TRUE, reticulate's default
+for `import()`, the mode the R `tensorflow`/`keras` packages — and ours —
+use; reference README.md:27-41 rides the same bridge):
+
+R -> Python:
+  NULL                         -> None
+  length-1 atomic vector       -> scalar (double->float, integer->int,
+                                  logical->bool, character->str)
+  length>1 atomic vector       -> list of scalars
+  matrix/array (double)        -> numpy float64 array
+  matrix/array (integer)       -> numpy int32 array
+  named list                   -> dict (recursive)
+  unnamed list                 -> list (recursive)
+  Python object (proxy)        -> the original object, unchanged
+
+Python -> R:
+  None                         -> NULL
+  bool/int/float/str           -> length-1 vector
+  numpy floating array         -> double array   (ALWAYS float64 — R has no
+                                                  float32 storage)
+  numpy int32/uint8/... array  -> integer array (int32)
+  numpy int64 array            -> double array (R has no int64)
+  dict                         -> named list (recursive)
+  list/tuple                   -> unnamed list (recursive)
+  anything else                -> opaque proxy (attribute access keeps
+                                  crossing the bridge)
+
+The faults this surfaces are reticulate's classic ones: float64 arrays where
+Python created float32/int64, scalars where R code forgot as.integer(),
+1-based seq_along arithmetic, and proxies leaking into R vector ops.
+
+The R functions themselves are transliterated 1:1 from r/distributedtpu/R/
+(file:line cited on each) — the transliteration is the test's spec, and
+test_reticulate_semantics.py asserts the chain coverage is 100%.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# R value model
+# --------------------------------------------------------------------------
+
+
+class RNull:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "NULL"
+
+
+NULL = RNull()
+
+
+class RVector:
+    """R atomic vector. Every R scalar is a length-1 vector."""
+
+    KINDS = ("double", "integer", "logical", "character")
+
+    def __init__(self, values, kind):
+        assert kind in self.KINDS, kind
+        self.values = list(values)
+        self.kind = kind
+
+    def __len__(self):
+        return len(self.values)
+
+    def __repr__(self):
+        return f"RVector({self.kind}, {self.values})"
+
+
+class RArray:
+    """R matrix/array: numpy storage restricted to R's types."""
+
+    def __init__(self, array, kind):
+        assert kind in ("double", "integer"), kind
+        dtype = np.float64 if kind == "double" else np.int32
+        self.array = np.asarray(array, dtype=dtype)
+        self.kind = kind
+
+
+class RList:
+    def __init__(self, items, names=None):
+        self.items = list(items)
+        self.names = list(names) if names is not None else None
+        if self.names is not None:
+            assert len(self.names) == len(self.items)
+
+    def __len__(self):
+        return len(self.items)
+
+    def get(self, name):
+        return self.items[self.names.index(name)]
+
+
+# R constructors ------------------------------------------------------------
+
+
+def r_double(*vals):
+    return RVector([float(v) for v in vals], "double")
+
+
+def r_int(*vals):
+    return RVector([int(v) for v in vals], "integer")
+
+
+def r_logical(*vals):
+    return RVector([bool(v) for v in vals], "logical")
+
+
+def r_character(*vals):
+    return RVector([str(v) for v in vals], "character")
+
+
+def r_c(*vectors):
+    """R's c() on same-kind vectors."""
+    kind = vectors[0].kind
+    vals = []
+    for v in vectors:
+        assert v.kind == kind
+        vals.extend(v.values)
+    return RVector(vals, kind)
+
+
+def as_integer(x):
+    """as.integer(): truncates doubles, keeps vector length."""
+    if isinstance(x, RVector):
+        return RVector([int(v) for v in x.values], "integer")
+    return r_int(int(x))
+
+
+def as_numeric(x):
+    if isinstance(x, RVector):
+        return RVector([float(v) for v in x.values], "double")
+    return r_double(float(x))
+
+
+def as_character(x):
+    if isinstance(x, RVector):
+        return RVector([str(v) for v in x.values], "character")
+    return r_character(str(x))
+
+
+def as_list(x):
+    """as.list() on an atomic vector: list of length-1 vectors."""
+    if isinstance(x, RVector):
+        return RList([RVector([v], x.kind) for v in x.values])
+    if isinstance(x, RList):
+        return x
+    raise TypeError(f"as.list on {type(x)}")
+
+
+def is_null(x):
+    return x is NULL or x is None
+
+
+def unlist(x):
+    """unlist(): flatten a list of atomic values into one vector."""
+    if isinstance(x, RVector):
+        return x
+    vals, kinds = [], set()
+    for item in x.items:
+        v = unlist(item)
+        vals.extend(v.values)
+        kinds.add(v.kind)
+    # R promotes mixed kinds; tests only hit homogeneous doubles.
+    kind = "double" if "double" in kinds else kinds.pop()
+    return RVector(vals, kind)
+
+
+def lapply(x, fn):
+    if isinstance(x, RList):
+        return RList([fn(v) for v in x.items], x.names)
+    raise TypeError("lapply expects an R list")
+
+
+def gsub(pattern, replacement, x):
+    import re
+
+    return RVector(
+        [re.sub(pattern, replacement, v) for v in x.values], "character"
+    )
+
+
+def paste0(*parts):
+    """paste0 with R recycling over the longest vector."""
+    vecs = []
+    n = 1
+    for p in parts:
+        if isinstance(p, RVector):
+            vecs.append([str(v) for v in p.values])
+            n = max(n, len(p))
+        else:
+            vecs.append([str(p)])
+    out = []
+    for i in range(n):
+        out.append("".join(v[i % len(v)] for v in vecs))
+    return RVector(out, "character")
+
+
+def seq_along(x):
+    return RVector(list(range(1, len(x) + 1)), "integer")
+
+
+def vec_add(a, b):
+    """R `+` on numeric vectors (recycled)."""
+    n = max(len(a), len(b))
+    kind = "integer" if a.kind == b.kind == "integer" else "double"
+    vals = [
+        a.values[i % len(a)] + b.values[i % len(b)] for i in range(n)
+    ]
+    return RVector(vals, kind)
+
+
+# --------------------------------------------------------------------------
+# jsonlite::toJSON(auto_unbox = TRUE)
+# --------------------------------------------------------------------------
+
+
+def to_json_auto_unbox(x) -> str:
+    """The serialization set_cluster_spec relies on (strategy.R:41-47;
+    reference README.md:89: auto_unbox so scalars serialize unboxed)."""
+
+    def conv(v):
+        if is_null(v):
+            return None
+        if isinstance(v, RVector):
+            if len(v) == 1:
+                return v.values[0]
+            return list(v.values)
+        if isinstance(v, RList):
+            if v.names is not None:
+                return {n: conv(i) for n, i in zip(v.names, v.items)}
+            return [conv(i) for i in v.items]
+        raise TypeError(f"toJSON: {type(v)}")
+
+    return json.dumps(conv(x), separators=(",", ":"))
+
+
+# --------------------------------------------------------------------------
+# The reticulate bridge
+# --------------------------------------------------------------------------
+
+
+def r_to_py(x):
+    if is_null(x):
+        return None
+    if isinstance(x, RVector):
+        vals = x.values
+        if len(vals) == 1:
+            return vals[0]
+        return list(vals)
+    if isinstance(x, RArray):
+        return x.array
+    if isinstance(x, RList):
+        converted = [r_to_py(v) for v in x.items]
+        if x.names is not None:
+            return dict(zip(x.names, converted))
+        return converted
+    if isinstance(x, RProxy):
+        return x._obj
+    # Already a Python value (e.g. a scalar produced by an earlier
+    # conversion being passed straight back through).
+    return x
+
+
+def py_to_r(obj):
+    if obj is None:
+        return NULL
+    if isinstance(obj, bool):
+        return r_logical(obj)
+    if isinstance(obj, (int, np.integer)):
+        return r_int(int(obj))
+    if isinstance(obj, (float, np.floating)):
+        return r_double(float(obj))
+    if isinstance(obj, str):
+        return r_character(obj)
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            return RProxy(obj)
+        if np.issubdtype(obj.dtype, np.floating):
+            return RArray(obj, "double")
+        if obj.dtype in (np.int32, np.int16, np.int8, np.uint8, np.uint16):
+            return RArray(obj, "integer")
+        if np.issubdtype(obj.dtype, np.integer):
+            # R has no 64-bit integer storage: reticulate converts int64
+            # to double.
+            return RArray(obj, "double")
+        if obj.dtype == bool:
+            return RArray(obj.astype(np.int32), "integer")
+        return RProxy(obj)
+    if isinstance(obj, dict):
+        return RList([py_to_r(v) for v in obj.values()],
+                     [str(k) for k in obj.keys()])
+    if isinstance(obj, (list, tuple)):
+        return RList([py_to_r(v) for v in obj])
+    return RProxy(obj)
+
+
+class RProxy:
+    """An R handle to a live Python object (reticulate's py_object)."""
+
+    def __init__(self, obj, _bridge=None, _path=""):
+        self._obj = obj
+        self._bridge = _bridge
+        self._path = _path
+
+    def attr(self, name):
+        """R `$` on a Python object: data attributes convert; callables
+        become R functions that marshal every call."""
+        path = f"{self._path}${name}" if self._path else name
+        if self._bridge is not None:
+            self._bridge.record(path)
+        value = getattr(self._obj, name)
+        if callable(value) and not isinstance(value, np.ndarray):
+            return RMethod(value, self._bridge, path)
+        if isinstance(value, (type(None), bool, int, float, str, np.ndarray,
+                              dict, list, tuple, np.integer, np.floating)):
+            return py_to_r(value)
+        return RProxy(value, self._bridge, path)
+
+    def set_attr(self, name, rvalue):
+        """R `obj$name <- value` (py_set_attr)."""
+        setattr(self._obj, name, r_to_py(rvalue))
+
+    def call(self, *args, **kwargs):
+        return RMethod(self._obj, self._bridge, self._path)(*args, **kwargs)
+
+
+class RMethod:
+    def __init__(self, fn, bridge, path):
+        self._fn = fn
+        self._bridge = bridge
+        self._path = path
+
+    def __call__(self, *args, **kwargs):
+        py_args = [r_to_py(a) for a in args]
+        py_kwargs = {k: r_to_py(v) for k, v in kwargs.items()}
+        result = self._fn(*py_args, **py_kwargs)
+        return py_to_r(result)
+
+
+class Bridge:
+    """reticulate::import("distributed_tpu") with chain recording."""
+
+    def __init__(self):
+        import distributed_tpu
+
+        self._module = distributed_tpu
+        self.chains: set = set()
+
+    def record(self, path):
+        self.chains.add(path)
+
+    def root(self) -> RProxy:
+        return RProxy(self._module, _bridge=self)
+
+
+# --------------------------------------------------------------------------
+# Transliterated R package (r/distributedtpu/R/*.R)
+# --------------------------------------------------------------------------
+
+
+class RBinding:
+    """Each method is the 1:1 transliteration of an exported R function,
+    operating only on R values + the bridge (never raw Python), so the
+    marshaling each R call performs is exercised for real."""
+
+    def __init__(self):
+        self._bridge = Bridge()
+
+    # package.R:11-16
+    def dtpu(self) -> RProxy:
+        return self._bridge.root()
+
+    # package.R:37-39
+    def dtpu_version(self):
+        return self.dtpu().attr("__version__")
+
+    # model.R:6-8
+    def mnist_cnn(self, num_classes=r_int(10)):
+        return self.dtpu().attr("models").attr("mnist_cnn")(
+            num_classes=as_integer(num_classes)
+        )
+
+    # model.R:11-13
+    def cifar_cnn(self, num_classes=r_int(10)):
+        return self.dtpu().attr("models").attr("cifar_cnn")(
+            num_classes=as_integer(num_classes)
+        )
+
+    # model.R:16-19
+    def resnet50(self, num_classes=r_int(1000), small_inputs=r_logical(False)):
+        return self.dtpu().attr("models").attr("resnet50")(
+            num_classes=as_integer(num_classes), small_inputs=small_inputs
+        )
+
+    # model.R:24-28
+    def dtpu_model(self, module, name=NULL):
+        return self.dtpu().attr("Model")(module, name=name)
+
+    # model.R:35-48
+    def compile(self, object, optimizer=r_character("sgd"),
+                loss=r_character("sparse_categorical_crossentropy"),
+                metrics=r_c(r_character("accuracy")),
+                learning_rate=NULL):
+        is_character = isinstance(optimizer, RVector) and \
+            optimizer.kind == "character"
+        if not is_null(learning_rate) and is_character:
+            optimizer = self.dtpu().attr("optim").attr("get")(
+                optimizer, learning_rate=as_numeric(learning_rate)
+            )
+        object.attr("compile")(
+            optimizer=optimizer, loss=loss, metrics=as_list(metrics)
+        )
+        return object
+
+    # model.R:57-79
+    def fit(self, object, x, y, batch_size=r_int(32), epochs=r_int(1),
+            steps_per_epoch=NULL, validation_data=NULL, verbose=r_int(1),
+            callbacks=None):
+        if callbacks is None:
+            callbacks = RList([])
+        h = object.attr("fit")(
+            x, y,
+            batch_size=as_integer(batch_size),
+            epochs=as_integer(epochs),
+            steps_per_epoch=NULL if is_null(steps_per_epoch)
+            else as_integer(steps_per_epoch),
+            validation_data=validation_data,
+            verbose=as_integer(verbose),
+            callbacks=callbacks,
+        )
+        hist = RList(
+            [lapply(h.attr("history"), unlist), object],
+            ["metrics", "model"],
+        )
+        return hist
+
+    # model.R:94-97
+    def evaluate(self, object, x, y, batch_size=r_int(32)):
+        res = object.attr("evaluate")(x, y, batch_size=as_integer(batch_size))
+        return lapply(res, as_numeric)
+
+    # model.R:100-102
+    def predict_on_batch(self, object, x, batch_size=r_int(32)):
+        return object.attr("predict")(x, batch_size=as_integer(batch_size))
+
+    # model.R:105
+    def summary_model(self, object):
+        return object.attr("summary")()
+
+    # model.R:110-113
+    def save_model_hdf5(self, object, filepath):
+        self.dtpu().attr("export_hdf5")(filepath, object.attr("params"))
+        return filepath
+
+    # model.R:117-121
+    def load_model_hdf5(self, object, filepath):
+        loaded = self.dtpu().attr("import_hdf5")(filepath)
+        # R 1-based [[1]]
+        params = loaded.items[0] if isinstance(loaded, RList) else loaded
+        object.set_attr(
+            "params",
+            object.attr("strategy").attr("put_params")(params),
+        )
+        return object
+
+    # model.R:128-133
+    def model_checkpoint_callback(self, directory, save_freq=r_character("epoch"),
+                                  keep=r_int(3), restore=r_logical(False)):
+        if isinstance(save_freq, RVector) and save_freq.kind in (
+            "double", "integer"
+        ):
+            save_freq = as_integer(save_freq)
+        return self.dtpu().attr("callbacks").attr("ModelCheckpoint")(
+            directory, save_freq=save_freq, keep=as_integer(keep),
+            restore=restore,
+        )
+
+    # model.R:136-141
+    def early_stopping_callback(self, monitor=r_character("loss"),
+                                patience=r_int(0), min_delta=r_double(0)):
+        return self.dtpu().attr("callbacks").attr("EarlyStopping")(
+            monitor=monitor, patience=as_integer(patience),
+            min_delta=as_numeric(min_delta),
+        )
+
+    # model.R:144
+    def csv_logger_callback(self, path):
+        return self.dtpu().attr("callbacks").attr("CSVLogger")(path)
+
+    # strategy.R:8
+    def single_device_strategy(self):
+        return self.dtpu().attr("SingleDevice")()
+
+    # strategy.R:12
+    def data_parallel_strategy(self):
+        return self.dtpu().attr("DataParallel")()
+
+    # strategy.R:17
+    def multi_worker_mirrored_strategy(self):
+        return self.dtpu().attr("MultiWorkerMirroredStrategy")()
+
+    # strategy.R:20
+    def num_replicas_in_sync(self, strategy):
+        return strategy.attr("num_replicas_in_sync")
+
+    # strategy.R:26-31
+    def with_strategy_scope(self, strategy, expr):
+        ctx = strategy.attr("scope")()
+        ctx.attr("__enter__")()
+        try:
+            return expr()
+        finally:
+            ctx.attr("__exit__")(NULL, NULL, NULL)
+
+    # strategy.R:40-50
+    def set_cluster_spec(self, workers, index):
+        spec = to_json_auto_unbox(
+            RList(
+                [
+                    RList([as_list(workers)], ["worker"]),
+                    RList(
+                        [r_character("worker"), as_integer(index)],
+                        ["type", "index"],
+                    ),
+                ],
+                ["cluster", "task"],
+            )
+        )
+        os.environ["DTPU_CONFIG"] = spec  # Sys.setenv
+        return spec
+
+    # strategy.R:56-60
+    def barrier_cluster_spec(self, addresses, partition,
+                             base_port=r_int(8000)):
+        hosts = gsub(r":[0-9]+$", "", addresses)
+        workers = paste0(hosts, ":", vec_add(base_port, seq_along(hosts)))
+        return self.set_cluster_spec(workers, as_integer(partition))
+
+    # data.R:5-12
+    def _load_split(self, name, normalize):
+        d = self.dtpu().attr("data").attr("load")(
+            name, r_character("train"), normalize=normalize
+        )
+        t = self.dtpu().attr("data").attr("load")(
+            name, r_character("test"), normalize=normalize
+        )
+        def split(v):
+            return RList([v.items[0], v.items[1]], ["x", "y"])
+        return RList([split(d), split(t)], ["train", "test"])
+
+    # data.R:16
+    def dataset_mnist(self, normalize=r_logical(True)):
+        return self._load_split(r_character("mnist"), normalize)
+
+    # data.R:19-21
+    def dataset_fashion_mnist(self, normalize=r_logical(True)):
+        return self._load_split(r_character("fashion_mnist"), normalize)
+
+    # data.R:24
+    def dataset_cifar10(self, normalize=r_logical(True)):
+        return self._load_split(r_character("cifar10"), normalize)

@@ -1,0 +1,393 @@
+"""Execute the R binding's call surface through simulated reticulate
+marshaling (VERDICT round 1, item 3).
+
+No R interpreter exists in this image, so `tests/reticulate_sim.py`
+transliterates every exported function in r/distributedtpu/R/*.R and drives
+the real Python package through reticulate's R<->Python conversion rules
+(doubles->float, integer vectors->int32, named lists->dicts, NULL->None,
+float32 arrays round-tripping as float64, ...).
+
+Covered flows mirror the reference end to end:
+- local train (reference README.md:45-76)
+- scoped distributed build + fit (README.md:118-154)
+- TF_CONFIG-shaped cluster specs incl. the Spark-barrier port rewrite
+  (README.md:84-89, 180-183)
+- HDF5 save/retrieve (README.md:236-247)
+- a real 2-process gang running the R-marshaled flow, asserting the
+  replicas-identical invariant (README.md:226-232)
+
+The final test asserts the harness covers 100% of the `dtpu()$...` call
+sites extracted from the R sources — the VERDICT's done-criterion.
+"""
+
+import json
+import re
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from reticulate_sim import (  # noqa: E402
+    NULL,
+    RArray,
+    RList,
+    RProxy,
+    RVector,
+    RBinding,
+    r_c,
+    r_character,
+    r_double,
+    r_int,
+    r_logical,
+    unlist,
+)
+
+R_SRC_DIR = Path(__file__).resolve().parents[1] / "r" / "distributedtpu" / "R"
+
+# Module-level binding shared across tests; the coverage test (defined last,
+# pytest runs file order) checks the union of recorded chains.
+RB = RBinding()
+
+
+@pytest.fixture
+def rb():
+    return RB
+
+
+def _fit_small(rb, model, x, y, **kw):
+    return rb.fit(model, x, y, batch_size=r_int(64), epochs=r_int(1),
+                  steps_per_epoch=r_int(5), verbose=r_int(0), **kw)
+
+
+def test_version_check(rb):
+    """tf_version() parity (reference README.md:40-41)."""
+    import distributed_tpu
+
+    v = rb.dtpu_version()
+    assert isinstance(v, RVector) and v.kind == "character"
+    assert v.values == [distributed_tpu.__version__]
+
+
+def test_local_flow_reference_readme_45_76(rb):
+    """The reference's local R trainer, through R marshaling end to end."""
+    d = rb.dataset_mnist()  # normalize=TRUE folds in the /255 of README.md:56
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    # reticulate delivered these as R arrays: doubles, even for labels.
+    assert isinstance(x, RArray) and x.kind == "double"
+    assert isinstance(y, RArray)
+    assert x.array.ndim == 4 and x.array.shape[1:] == (28, 28, 1)
+    assert float(x.array.max()) <= 1.0 + 1e-9
+
+    model = rb.dtpu_model(rb.mnist_cnn(r_int(10)))
+    rb.compile(model, optimizer=r_character("sgd"),
+               learning_rate=r_double(0.05),
+               loss=r_character("sparse_categorical_crossentropy"),
+               metrics=r_c(r_character("accuracy")))
+    h = _fit_small(rb, model, x, y)
+    metrics = h.get("metrics")
+    loss = metrics.get("loss")
+    acc = metrics.get("accuracy")
+    # result$metrics$accuracy must be a plain numeric vector (the value the
+    # reference's Spark closure reads, README.md:220) — proxies leaking here
+    # would break max()/as.character() on the R side.
+    assert isinstance(loss, RVector) and loss.kind == "double"
+    assert isinstance(acc, RVector) and acc.kind == "double"
+    assert len(loss) == 1 and np.isfinite(loss.values[0])
+    assert 0.0 <= acc.values[0] <= 1.0
+
+
+def test_evaluate_and_predict_marshaling(rb):
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    model = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model, learning_rate=r_double(0.05))
+    _fit_small(rb, model, x, y)
+
+    xs = RArray(x.array[:64], "double")
+    ys = RArray(y.array[:64], y.kind)
+    res = rb.evaluate(model, xs, ys, batch_size=r_int(32))
+    assert isinstance(res, RList) and "loss" in res.names
+    for item in res.items:
+        assert isinstance(item, RVector) and item.kind == "double"
+
+    preds = rb.predict_on_batch(model, xs, batch_size=r_int(32))
+    # float32 logits arrive in R as a double array.
+    assert isinstance(preds, RArray) and preds.kind == "double"
+    assert preds.array.shape == (64, 10)
+
+    rb.summary_model(model)
+
+
+def test_validation_data_as_r_list(rb):
+    """fit(validation_data = list(x, y)) — an unnamed R list crossing as a
+    Python [x, y] list (the README's val-metrics surface)."""
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    val = RList([RArray(x.array[:64], "double"), RArray(y.array[:64], y.kind)])
+    model = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model, learning_rate=r_double(0.05))
+    h = _fit_small(rb, model, x, y, validation_data=val)
+    metrics = h.get("metrics")
+    assert "val_loss" in metrics.names
+    assert metrics.get("val_loss").kind == "double"
+
+
+def test_scoped_distributed_build_readme_118_154(rb):
+    """strategy + with(strategy$scope(), {build}) + global-batch fit."""
+    strategy = rb.multi_worker_mirrored_strategy()
+    n = rb.num_replicas_in_sync(strategy)
+    assert isinstance(n, RVector) and n.kind == "integer"
+    num_replicas = n.values[0]
+    assert num_replicas == 8  # the CPU sim mesh
+
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+
+    built = {}
+
+    def build_model():
+        m = rb.dtpu_model(rb.mnist_cnn())
+        rb.compile(m, learning_rate=r_double(0.05))
+        built["m"] = m
+        return m
+
+    rb.with_strategy_scope(strategy, build_model)
+    # global batch = per-worker 64 x replicas (README.md:124-125)
+    gb = 8 * num_replicas
+    h = rb.fit(built["m"], x, y, batch_size=r_int(gb), epochs=r_int(1),
+               steps_per_epoch=r_int(3), verbose=r_int(0))
+    assert len(h.get("metrics").get("loss")) == 1
+
+    # Also exercise the two plain strategy constructors the R API exports.
+    assert rb.num_replicas_in_sync(rb.single_device_strategy()).values[0] == 1
+    assert rb.num_replicas_in_sync(rb.data_parallel_strategy()).values[0] == 8
+
+
+def test_cluster_spec_schema_readme_84_89(rb, monkeypatch):
+    monkeypatch.delenv("DTPU_CONFIG", raising=False)
+    workers = r_c(
+        r_character("10.0.0.1:10087"), r_character("10.0.0.2:10088"),
+        r_character("10.0.0.3:10089"), r_character("10.0.0.4:10090"),
+    )
+    spec_json = rb.set_cluster_spec(workers, r_int(2))
+    spec = json.loads(spec_json)
+    # Exact reference schema (README.md:84-89), auto_unbox semantics:
+    # scalars unboxed, the worker list stays a list.
+    assert spec == {
+        "cluster": {"worker": ["10.0.0.1:10087", "10.0.0.2:10088",
+                               "10.0.0.3:10089", "10.0.0.4:10090"]},
+        "task": {"type": "worker", "index": 2},
+    }
+    from distributed_tpu.cluster import from_env
+
+    parsed = from_env()
+    assert parsed.index == 2
+    assert parsed.num_processes == 4
+    assert parsed.workers[0] == "10.0.0.1:10087"
+
+
+def test_single_worker_spec_stays_listy(rb, monkeypatch):
+    """jsonlite auto_unbox would collapse a length-1 worker vector to a JSON
+    scalar — the as.list() in strategy.R:43 prevents it. Pin that."""
+    monkeypatch.delenv("DTPU_CONFIG", raising=False)
+    spec = json.loads(rb.set_cluster_spec(r_character("h:1"), r_int(0)))
+    assert spec["cluster"]["worker"] == ["h:1"]
+
+
+def test_barrier_cluster_spec_readme_180_183(rb, monkeypatch):
+    """Spark's ports stripped, 8000+seq_along(hosts) (1-based!) assigned."""
+    monkeypatch.delenv("DTPU_CONFIG", raising=False)
+    addresses = r_c(r_character("10.1.1.1:34567"),
+                    r_character("10.1.1.2:34568"),
+                    r_character("10.1.1.3:34569"))
+    rb.barrier_cluster_spec(addresses, r_int(1))
+    spec = json.loads(__import__("os").environ["DTPU_CONFIG"])
+    assert spec["cluster"]["worker"] == [
+        "10.1.1.1:8001", "10.1.1.2:8002", "10.1.1.3:8003"
+    ]
+    assert spec["task"]["index"] == 1
+
+
+def test_hdf5_save_load_roundtrip_readme_236_247(rb, tmp_path):
+    """save_model_hdf5 / load_model_hdf5 through R marshaling: float32
+    params come back to R as float64 and must load back losslessly (JAX
+    casts to the weak dtype on placement)."""
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    model = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model, learning_rate=r_double(0.05))
+    _fit_small(rb, model, x, y)
+
+    path = str(tmp_path / "model.hdf5")
+    rb.save_model_hdf5(model, r_character(path))
+
+    xs = RArray(x.array[:32], "double")
+    before = rb.predict_on_batch(model, xs).array
+
+    model2 = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model2, learning_rate=r_double(0.05))
+    # load_model_hdf5 requires a built model (model.R:116).
+    model2._obj.build((28, 28, 1))
+    rb.load_model_hdf5(model2, r_character(path))
+    after = rb.predict_on_batch(model2, xs).array
+    np.testing.assert_allclose(before, after, atol=1e-5)
+
+
+def test_callbacks_constructed_from_r(rb, tmp_path):
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    model = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model, learning_rate=r_double(0.05))
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    csv_path = str(tmp_path / "log.csv")
+    cbs = RList([
+        rb.model_checkpoint_callback(r_character(ckpt_dir),
+                                     save_freq=r_character("epoch"),
+                                     keep=r_int(2)),
+        rb.early_stopping_callback(monitor=r_character("loss"),
+                                   patience=r_int(1)),
+        rb.csv_logger_callback(r_character(csv_path)),
+    ])
+    h = rb.fit(model, x, y, batch_size=r_int(64), epochs=r_int(2),
+               steps_per_epoch=r_int(2), verbose=r_int(0), callbacks=cbs)
+    assert len(h.get("metrics").get("loss")) == 2
+    assert Path(csv_path).exists()
+    assert any(Path(ckpt_dir).iterdir())
+
+    # numeric save_freq goes through the as.integer branch (model.R:130)
+    cb = rb.model_checkpoint_callback(r_character(ckpt_dir),
+                                      save_freq=r_double(5.0))
+    assert cb._obj.save_freq == 5
+
+
+def test_resnet_and_cifar_constructors(rb):
+    """The other two model constructors model.R exports; logical and integer
+    marshaling on their arguments."""
+    m = rb.dtpu_model(rb.resnet50(num_classes=r_int(10),
+                                  small_inputs=r_logical(True)))
+    rb.compile(m, learning_rate=r_double(0.1))
+    m._obj.build((32, 32, 3))
+    assert m._obj.num_params > 0
+
+    c = rb.dtpu_model(rb.cifar_cnn(r_int(10)))
+    rb.compile(c)
+    c._obj.build((32, 32, 3))
+
+
+def test_other_dataset_loaders(rb):
+    for d in (rb.dataset_fashion_mnist(), rb.dataset_cifar10()):
+        x = d.get("train").get("x")
+        assert isinstance(x, RArray) and x.array.ndim == 4
+
+
+@pytest.mark.slow
+def test_distributed_2proc_r_flow(tmp_path):
+    """The reference's Spark-barrier distributed run (README.md:170-232),
+    R-marshaled: 2 gang processes each build the cluster spec via
+    barrier_cluster_spec, train under the mirrored strategy, and return
+    max(result$metrics$accuracy) as.character — identical on every worker
+    (README.md:226-232)."""
+    import textwrap
+
+    from distributed_tpu.launch import LocalLauncher
+
+    repo = str(Path(__file__).resolve().parents[1])
+    tests_dir = str(Path(__file__).resolve().parent)
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {repo!r})
+        sys.path.insert(0, {tests_dir!r})
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+        # The launcher injects DTPU_CONFIG; re-derive barrier-style inputs
+        # from it, then rebuild the spec the R way (barrier$address carries
+        # Spark ports that must be stripped and re-assigned). Use the
+        # original ports as the base so the rewritten spec still points at
+        # the live gang.
+        import json
+        env_spec = json.loads(os.environ["DTPU_CONFIG"])
+        peers = env_spec["cluster"]["worker"]
+        rank = env_spec["task"]["index"]
+        ports = [int(p.rsplit(":", 1)[1]) for p in peers]
+
+        from reticulate_sim import (RBinding, RList, r_character, r_c,
+                                    r_int, r_double)
+        rb = RBinding()
+        addresses = r_c(*[r_character(h.rsplit(":", 1)[0] + ":34567")
+                          for h in peers])
+        # base_port chosen so 8000+seq lands on the real gang ports.
+        rb.barrier_cluster_spec(addresses, r_int(rank),
+                                base_port=r_int(ports[0] - 1))
+        spec = json.loads(os.environ["DTPU_CONFIG"])
+        assert spec["task"]["index"] == rank
+        # seq_along must have preserved rank order of the original list
+        expect = [p.rsplit(":", 1)[0] + ":" + str(ports[0] + i)
+                  for i, p in enumerate(peers, start=1)]
+        assert spec["cluster"]["worker"] == [
+            p.rsplit(":", 1)[0] + ":" + str(ports[0] - 1 + i)
+          for i, p in enumerate(peers, start=1)], spec
+
+        # Port rewriting can't target the actual listener ports the
+        # launcher opened, so restore the real spec for initialize() —
+        # the schema round-trip above is the marshaling test.
+        os.environ["DTPU_CONFIG"] = json.dumps(env_spec)
+
+        import distributed_tpu as dtpu
+        dtpu.cluster.initialize()
+
+        d = rb.dataset_mnist()
+        train = d.get("train")
+        x, y = train.get("x"), train.get("y")
+
+        built = {{}}
+        def build():
+            m = rb.dtpu_model(rb.mnist_cnn())
+            rb.compile(m, learning_rate=r_double(0.05))
+            built["m"] = m
+        strategy = rb.multi_worker_mirrored_strategy()
+        rb.with_strategy_scope(strategy, build)
+        h = rb.fit(built["m"], x, y, batch_size=r_int(64), epochs=r_int(2),
+                   steps_per_epoch=r_int(3), verbose=r_int(0))
+        # as.character(max(result$metrics$accuracy)) (README.md:220)
+        acc = max(h.get("metrics").get("accuracy").values)
+        from distributed_tpu.launch import report_result
+        report_result({{"rank": rank, "acc_chr": repr(acc)}})
+        """))
+    results = LocalLauncher().run([sys.executable, str(script)], 2,
+                                  timeout=300)
+    assert all(r.ok for r in results), [
+        (r.index, r.error, r.log_tail[-800:]) for r in results
+    ]
+    accs = {r.value["acc_chr"] for r in results}
+    assert len(accs) == 1  # replicas identical, README.md:226-232
+
+
+# -- keep last: coverage over every dtpu()$... call site --------------------
+
+
+def test_chain_coverage_is_100_percent():
+    """Every `dtpu()$<chain>` in r/distributedtpu/R/*.R was executed
+    through the marshaling harness above (VERDICT #3 done-criterion)."""
+    src = "\n".join(p.read_text() for p in sorted(R_SRC_DIR.glob("*.R")))
+    chains = set(re.findall(r"dtpu\(\)\$(`?[A-Za-z_][A-Za-z_$0-9]*`?)", src))
+    chains = {c.replace("`", "") for c in chains}
+    assert chains, "no call sites found — extraction regex broke"
+    recorded = RB._bridge.chains
+    missing = {c for c in chains if c not in recorded}
+    assert not missing, (
+        f"R call sites never executed through the harness: {sorted(missing)};"
+        f" executed: {sorted(recorded)}"
+    )
