@@ -38,13 +38,22 @@ class MultiHeadAttention(Layer):
         use_bias: bool = True,
         dtype=None,
         ring_axis: Optional[str] = "seq",
+        flash="auto",
         name: Optional[str] = None,
     ):
         """``ring_axis``: when the ambient strategy's mesh has this axis with
         size > 1 (sequence parallelism), attention runs as ring attention
         over it (ops.ring_attention) — K/V rotate between sequence shards
         instead of being all-gathered. Irrelevant (dense path) otherwise;
-        set None to force dense attention even under a seq mesh."""
+        set None to force dense attention even under a seq mesh.
+
+        ``flash``: True runs the Pallas flash-attention kernel
+        (ops.flash_attention — O(T*D) HBM instead of the (T, T) score
+        tensor); False keeps the dense einsum path; "auto" (default) uses
+        flash on TPU for sequences >= 512. Under a sharded mesh the kernel
+        runs per-shard via shard_map (parallel.auto_shard) so GSPMD never
+        replicates it; ring attention still takes precedence under a seq
+        mesh."""
         super().__init__(name)
         self.num_heads = int(num_heads)
         self.head_dim = head_dim
@@ -52,6 +61,7 @@ class MultiHeadAttention(Layer):
         self.use_bias = use_bias
         self.dtype = dtype
         self.ring_axis = ring_axis
+        self.flash = flash
 
     def init(self, key, input_shape: Shape):
         d = input_shape[-1]
@@ -103,6 +113,30 @@ class MultiHeadAttention(Layer):
             batch_axis = None
         return mesh, batch_axis
 
+    def _use_flash(self, t: int) -> bool:
+        if self.flash is True:
+            return True
+        if self.flash == "auto":
+            return t >= 512 and jax.default_backend() == "tpu"
+        return False
+
+    def _flash_call(self, q, k, v):
+        """Flash attention, per-shard under the ambient mesh (batch on the
+        strategy's data axis, heads on the Megatron 'model' axis)."""
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+        from ..ops.flash_attention import flash_attention
+        from ..parallel.auto_shard import ambient_mesh, shard_rows
+
+        fn = functools.partial(flash_attention, causal=self.causal)
+        mesh, batch_axis, model_axis = ambient_mesh()
+        if mesh is None:
+            return fn(q, k, v)
+        spec = P(batch_axis, None, model_axis, None)
+        return shard_rows(fn, (q, k, v), (spec, spec, spec), spec)
+
     def _proj(self, params, x, w, b):
         kernel = params[w]
         if self.dtype is not None:
@@ -132,19 +166,21 @@ class MultiHeadAttention(Layer):
                 seq_axis=self.ring_axis,
                 batch_axis=batch_axis,
                 causal=self.causal,
-            ).reshape(b, t, h * hd)
-            out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
-            if self.use_bias:
-                out = out + params["bo"].astype(out.dtype)
-            return out, {}
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(jnp.float32(hd))
-        if self.causal:
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
-        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(b, t, h * hd)
+            )
+        elif self._use_flash(t):
+            ctx = self._flash_call(q, k, v)
+        else:
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(jnp.float32(hd))
+            if self.causal:
+                mask = jnp.tril(jnp.ones((t, t), bool))
+                scores = jnp.where(
+                    mask[None, None], scores, jnp.float32(-1e30)
+                )
+            attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        ctx = ctx.reshape(b, t, h * hd)
         out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)

@@ -1,3 +1,17 @@
 from . import losses, metrics
 
-__all__ = ["losses", "metrics"]
+__all__ = ["losses", "metrics", "flash_attention", "ring_attention"]
+
+
+def __getattr__(name):
+    # Lazy: flash/ring attention import jax.experimental.pallas / shard_map
+    # machinery not needed by the common CNN paths.
+    if name == "flash_attention":
+        from .flash_attention import flash_attention
+
+        return flash_attention
+    if name == "ring_attention":
+        from .ring_attention import ring_attention
+
+        return ring_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,257 @@
+"""Flash attention: Pallas TPU forward kernel + blockwise backward.
+
+The dense attention path materializes the (B, H, T, T) score tensor in HBM —
+at T=8k and 12 heads that is the whole memory budget. This kernel computes
+softmax(QK^T)V with the online-softmax recurrence entirely in VMEM: the
+grid walks (batch*heads, q_blocks, kv_blocks) with the kv dimension
+innermost and sequential, carrying the running max/sum/accumulator in
+scratch, so HBM traffic is O(T*D) instead of O(T^2).
+
+The backward pass recomputes probabilities blockwise in plain JAX from the
+saved per-row statistics (m, l) — flash-style rematerialization; one scan
+over kv blocks yields dq/dk/dv without ever holding a full (T, T) matrix.
+XLA maps each block's matmuls onto the MXU, which is where all the FLOPs
+are; the Pallas win in the forward is fusing the softmax recurrence into
+the matmul stream.
+
+The reference has no attention anywhere (SURVEY.md §2c); this is part of the
+long-context tier the framework adds (with ops.ring_attention for the
+sequence-parallel case — ring attention distributes *across chips*, flash
+attention blocks *within* a chip; MultiHeadAttention composes them).
+
+CPU/tests run the same kernel via Pallas interpret mode; on TPU it compiles
+to Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+_NEG = -1e30
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                m_ref, l_ref, acc_ref,
+                *, scale, block_q, block_k, t_actual, causal, nk):
+    """One (bh, qi, ki) grid step. Scratch carries the online-softmax state
+    across the sequential ki dimension."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Causal: a kv block strictly above the diagonal band contributes
+    # nothing — skip its matmuls entirely (the scratch carries through).
+    def compute():
+        # Keep inputs in their storage dtype for the MXU (bf16 matmul with
+        # f32 accumulate); only the softmax recurrence runs in f32.
+        q = q_ref[0]  # (block_q, d_pad)
+        k = k_ref[0]  # (block_k, d_pad)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k) f32
+
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < t_actual
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        s = jnp.where(valid, s, _NEG)
+
+        m_prev = m_ref[...]  # (block_q, 128), all lanes equal
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])  # (block_q, 1)
+        p = jnp.exp(s - m_new[:, :1])  # (block_q, block_k)
+        l_new = l_prev * alpha + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape
+        )
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    if causal:
+        # Not taken only when the whole block is above the diagonal.
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        m_out_ref[0] = m_ref[...]
+        l_out_ref[0] = l_ref[...]
+
+
+def _fwd_pallas(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: (BH, T, D). Returns (out, m_rows, l_rows) with m/l: (BH, T)."""
+    bh, t, d = q.shape
+    if max(block_q, block_k) % min(block_q, block_k):
+        raise ValueError(
+            f"block_q={block_q} and block_k={block_k} must divide each "
+            "other, or trailing rows would fall outside the grid"
+        )
+    t_pad = _round_up(t, max(block_q, block_k))
+    d_pad = _round_up(max(d, 128), 128)
+    pad = lambda x: jnp.pad(
+        x, ((0, 0), (0, t_pad - t), (0, d_pad - d))
+    )
+    qp, kp, vp = pad(q), pad(k), pad(v)
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        t_actual=t, causal=causal, nk=nk,
+    )
+    out, m_out, l_out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t_pad, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            # m, l, acc live across the sequential ki dimension.
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d_pad), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :t, :d], m_out[:, :t, 0], l_out[:, :t, 0]
+
+
+# ----------------------------------------------------------------- backward
+def _bwd_blockwise(res, g, *, scale, causal, block_k):
+    """Blockwise dq/dk/dv from saved row stats. One scan over kv blocks;
+    peak extra memory is (T, block_k) per step instead of (T, T)."""
+    q, k, v, out, m_rows, l_rows = res
+    bh, t, d = q.shape
+    t_pad = _round_up(t, block_k)
+    nk = t_pad // block_k
+    kp = jnp.pad(k, ((0, 0), (0, t_pad - t), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, t_pad - t), (0, 0)))
+
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # D_i = sum_j dO_ij * O_ij  (rowwise)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (bh, t)
+    m_b = m_rows[..., None]  # (bh, t, 1)
+    l_b = jnp.maximum(l_rows[..., None], 1e-30)
+
+    row_ids = jnp.arange(t)[None, :, None]  # (1, t, 1)
+
+    def step(dq_acc, j):
+        kj = jax.lax.dynamic_slice_in_dim(kp, j * block_k, block_k, axis=1)
+        vj = jax.lax.dynamic_slice_in_dim(vp, j * block_k, block_k, axis=1)
+        kjf = kj.astype(jnp.float32)
+        vjf = vj.astype(jnp.float32)
+        s = jnp.einsum(
+            "btd,bkd->btk", qf, kjf, preferred_element_type=jnp.float32
+        ) * scale
+        col_ids = j * block_k + jnp.arange(block_k)[None, None, :]
+        valid = col_ids < t
+        if causal:
+            valid = jnp.logical_and(valid, col_ids <= row_ids)
+        p = jnp.where(valid, jnp.exp(s - m_b) / l_b, 0.0)  # (bh, t, bk)
+        dv_j = jnp.einsum("btk,btd->bkd", p, gf)
+        dp = jnp.einsum("btd,bkd->btk", gf, vjf)
+        ds = p * (dp - delta[..., None]) * scale
+        dk_j = jnp.einsum("btk,btd->bkd", ds, qf)
+        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, kjf)
+        return dq_acc, (dk_j, dv_j)
+
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        step, jnp.zeros_like(qf), jnp.arange(nk)
+    )
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# -------------------------------------------------------------------- public
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+)
+def _flash(q, k, v, causal, block_q, block_k):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out, _, _ = _fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out, m_rows, l_rows = _fwd_pallas(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, m_rows, l_rows)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    scale = 1.0 / np.sqrt(res[0].shape[-1])
+    return _bwd_blockwise(res, g, scale=scale, causal=causal,
+                          block_k=block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = False,
+    block_q: int = 256, block_k: int = 512,
+):
+    """softmax(Q K^T / sqrt(d)) V without materializing the (T, T) scores.
+
+    q, k, v: (B, T, H, D) — same layout MultiHeadAttention produces.
+    Returns (B, T, H, D) in q's dtype. Scores/softmax compute in float32.
+    """
+    b, t, h, d = q.shape
+    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+    rt = _round_up(t, 8)
+    bq = min(block_q, rt)
+    bk = min(block_k, rt)
+    if max(bq, bk) % min(bq, bk):  # clamping broke divisibility
+        bq = bk = min(bq, bk)
+    out = _flash(fold(q), fold(k), fold(v), causal, bq, bk)
+    return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)

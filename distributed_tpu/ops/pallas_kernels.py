@@ -190,6 +190,26 @@ def _stock_fallback(c: int) -> bool:
     return True
 
 
+def _sharded_fused_xent(flat_logits, flat_labels):
+    """fused_softmax_xent per-shard under the ambient mesh: GSPMD cannot
+    partition a Pallas custom call (it would all-gather the logits and run
+    the global problem on every device), so batch-sharded rows go through
+    shard_map (parallel.auto_shard). Plain call off-mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.auto_shard import ambient_mesh, shard_rows
+
+    mesh, batch_axis, _ = ambient_mesh()
+    if mesh is None or batch_axis is None:
+        return fused_softmax_xent(flat_logits, flat_labels)
+    return shard_rows(
+        fused_softmax_xent,
+        (flat_logits, flat_labels),
+        (P(batch_axis, None), P(batch_axis)),
+        P(batch_axis),
+    )
+
+
 def pallas_sparse_categorical_crossentropy(logits, labels):
     """Mean fused cross-entropy — drop-in for the stock loss via
     ``compile(loss="pallas_sparse_categorical_crossentropy")``.
@@ -203,7 +223,7 @@ def pallas_sparse_categorical_crossentropy(logits, labels):
 
         return losses.sparse_categorical_crossentropy(logits, labels)
     flat = logits.reshape(-1, c)
-    return jnp.mean(fused_softmax_xent(flat, labels.reshape(-1)))
+    return jnp.mean(_sharded_fused_xent(flat, labels.reshape(-1)))
 
 
 def per_example_pallas_xent(logits, labels):
@@ -212,5 +232,5 @@ def per_example_pallas_xent(logits, labels):
         from . import losses
 
         return losses._per_example_sparse_cce(logits, labels)
-    out = fused_softmax_xent(logits.reshape(-1, c), labels.reshape(-1))
+    out = _sharded_fused_xent(logits.reshape(-1, c), labels.reshape(-1))
     return out.reshape(labels.shape)

@@ -1,0 +1,91 @@
+"""Route Pallas kernels around GSPMD's custom-call replication.
+
+XLA's SPMD partitioner cannot see inside a Pallas kernel, so under a sharded
+mesh it wraps the call in all-gather(inputs) -> replicated compute ->
+dynamic-slice(output): correct, but the kernel then runs the GLOBAL problem
+on every device (verified by compiling flash attention under a 'data'-sharded
+batch and finding the all-gather in the HLO). The fix is shard_map: run the
+kernel per-shard on local data, which is exactly right for row/batch-blocked
+kernels (fused xent, flash attention) whose grid never crosses rows.
+
+``shard_rows(fn, arrays, specs)`` wraps fn in shard_map over the ambient
+strategy's mesh when — and only when — that is safe:
+
+- every mesh axis of size > 1 is either the strategy's batch axis or the
+  Megatron 'model' axis (axes with bespoke schedules — 'pipe', 'seq' — keep
+  the plain path; their strategies have their own machinery);
+- every array dim sharded by a spec divides evenly.
+
+Otherwise the plain call runs (GSPMD replication on multi-device, which is
+still correct — and free on a single device, where there is nothing to
+replicate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+try:  # modern location (jax>=0.8)
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+import inspect
+
+# Replication checking was renamed check_rep -> check_vma in jax 0.8.
+_sig = inspect.signature(shard_map).parameters
+if "check_vma" in _sig:
+    _CHECK_KWARGS = {"check_vma": False}
+elif "check_rep" in _sig:  # pragma: no cover - older jax
+    _CHECK_KWARGS = {"check_rep": False}
+else:  # pragma: no cover
+    _CHECK_KWARGS = {}
+del _sig
+
+
+def ambient_mesh() -> Tuple[Optional[Mesh], Optional[str], Optional[str]]:
+    """(mesh, batch_axis, model_axis) from the ambient strategy scope.
+
+    model_axis is 'model' when present in the mesh (the Megatron TP axis,
+    parallel.mesh.AXES), else None. mesh is None outside any mesh strategy.
+    """
+    from .strategy import current_strategy
+
+    strat = current_strategy()
+    mesh = getattr(strat, "mesh", None)
+    if mesh is None:
+        return None, None, None
+    batch_axis = getattr(strat, "axis", None)
+    if batch_axis not in mesh.axis_names:
+        batch_axis = None
+    model_axis = "model" if "model" in mesh.axis_names else None
+    return mesh, batch_axis, model_axis
+
+
+def shard_rows(fn, arrays: Sequence, in_specs: Sequence[PartitionSpec],
+               out_spec: PartitionSpec):
+    """Apply fn(*arrays) under shard_map over the ambient mesh when safe
+    (see module docstring), else call it plainly."""
+    mesh, batch_axis, model_axis = ambient_mesh()
+    if mesh is None:
+        return fn(*arrays)
+    allowed = {batch_axis, model_axis, None}
+    for name in mesh.axis_names:
+        if int(mesh.shape[name]) > 1 and name not in allowed:
+            return fn(*arrays)
+    # Divisibility of every sharded dim, or fall back.
+    for arr, spec in zip(arrays, in_specs):
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            if int(mesh.shape[axis]) > 1 and arr.shape[dim] % int(
+                mesh.shape[axis]
+            ):
+                return fn(*arrays)
+    return shard_map(
+        fn, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_spec,
+        **_CHECK_KWARGS,
+    )(*arrays)

@@ -1,0 +1,165 @@
+"""Flash attention: numerics vs dense (values AND grads), shard_map routing.
+
+The kernel runs in Pallas interpret mode on CPU (same semantics as the
+Mosaic build on TPU). The sharding tests compile under the 8-device sim and
+assert GSPMD never all-gathers the kernel inputs — the failure mode
+parallel.auto_shard exists to prevent.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.ops.flash_attention import flash_attention
+
+
+def dense_attention(q, k, v, causal):
+    b, t, h, d = q.shape
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+
+def _qkv(shape, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(
+        jnp.asarray(rng.standard_normal(shape), dtype) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 64, 2, 16), (1, 100, 3, 32)])
+def test_matches_dense_values_and_grads(shape, causal):
+    q, k, v = _qkv(shape)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+            * v
+        )
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal) * v)
+
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = dense_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=1e-4)
+
+
+def test_ragged_seq_and_uneven_blocks():
+    # T=257: padding rows/cols must not leak into real outputs.
+    q, k, v = _qkv((1, 257, 2, 64))
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dense_attention(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_incompatible_blocks_are_repaired():
+    """Mismatched block sizes are clamped to a compatible pair instead of
+    silently dropping trailing rows (the grid must cover all of T)."""
+    q, k, v = _qkv((1, 256, 1, 16))
+    out = flash_attention(q, k, v, causal=True, block_q=96, block_k=128)
+    ref = dense_attention(q, k, v, True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_bf16_inputs():
+    q, k, v = _qkv((2, 128, 2, 32), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = dense_attention(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(np.float32), ref.astype(np.float32), atol=3e-2
+    )
+
+
+def test_no_allgather_under_dp_mesh(devices):
+    """shard_rows must keep the kernel per-shard: compiling under a
+    'data'-sharded batch may not introduce an all-gather of q/k/v."""
+    strategy = dtpu.DataParallel()
+    b, t, h, d = 16, 64, 2, 32
+    q, k, v = _qkv((b, t, h, d))
+    batch = strategy.put_batch({"x": np.asarray(q)})
+    qs = batch["x"]
+
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_tpu.parallel.auto_shard import shard_rows
+
+    def call(q, k, v):
+        with strategy.scope():
+            spec = P("data", None, None, None)
+            return shard_rows(
+                lambda a, b2, c: flash_attention(
+                    a, b2, c, causal=True, block_q=32, block_k=32
+                ),
+                (q, k, v), (spec, spec, spec), spec,
+            )
+
+    f = jax.jit(call)
+    hlo = f.lower(qs, k, v).compile().as_text()
+    assert "all-gather" not in hlo
+    out = f(qs, k, v)
+    ref = dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=1e-5)
+
+
+def test_mha_flash_equals_dense_model_level(devices):
+    """A transformer LM with flash=True in every MHA must match the dense
+    attention model's loss exactly enough for training parity."""
+    import distributed_tpu.nn as nn
+
+    def make(flash):
+        return nn.Sequential([
+            nn.Embedding(64, 32),
+            nn.MultiHeadAttention(4, causal=True, flash=flash),
+            nn.Dense(64),
+        ])
+
+    x = np.asarray(
+        np.random.default_rng(0).integers(0, 64, (8, 96)), np.int32
+    )
+    ma, mb = make(True), make(False)
+    pa, sa, _ = ma.init(jax.random.PRNGKey(0), (96,))
+    logits_a, _ = ma.apply(pa, {}, x)
+    logits_b, _ = mb.apply(pa, {}, x)  # identical params
+    np.testing.assert_allclose(logits_a, logits_b, atol=2e-4, rtol=1e-4)
+
+
+def test_fused_xent_sharded_no_allgather(devices):
+    """The Pallas loss inside a DP step must also stay per-shard."""
+    from distributed_tpu.ops.pallas_kernels import (
+        pallas_sparse_categorical_crossentropy,
+    )
+
+    strategy = dtpu.DataParallel()
+    n, c = 64, 32
+    rng = np.random.default_rng(0)
+    logits = np.asarray(rng.standard_normal((n, c)), np.float32)
+    labels = np.asarray(rng.integers(0, c, (n,)), np.int32)
+    batch = strategy.put_batch({"x": logits, "y": labels})
+
+    def loss(lg, lb):
+        with strategy.scope():
+            return pallas_sparse_categorical_crossentropy(lg, lb)
+
+    f = jax.jit(loss)
+    hlo = f.lower(batch["x"], batch["y"]).compile().as_text()
+    assert "all-gather" not in hlo
+    got = float(f(batch["x"], batch["y"]))
+    from distributed_tpu.ops import losses
+
+    want = float(losses.sparse_categorical_crossentropy(logits, labels))
+    assert abs(got - want) < 1e-5
