@@ -146,6 +146,52 @@ class MultiHeadAttention(Layer):
             y = y + params[b].astype(y.dtype)
         return y
 
+    # ------------------------------------------------- incremental decode --
+    decode_safe = True  # via the cached override below
+
+    def init_cache(self, params, batch, max_len, dtype):
+        inner = params["wq"].shape[1]
+        hd = inner // self.num_heads
+        shape = (batch, max_len, self.num_heads, hd)
+        cdtype = self.dtype or dtype
+        return {
+            "k": jnp.zeros(shape, cdtype),
+            "v": jnp.zeros(shape, cdtype),
+        }
+
+    def decode(self, params, state, cache, x, *, pos):
+        """One-token attention over the KV cache: x (B, 1, D), the new K/V
+        row written at ``pos``, scores masked to positions <= pos."""
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
+        b = x.shape[0]
+        h = self.num_heads
+        hd = params["wq"].shape[1] // h
+        q = self._proj(params, x, "wq", "bq").reshape(b, 1, h, hd)
+        k = self._proj(params, x, "wk", "bk").reshape(b, 1, h, hd)
+        v = self._proj(params, x, "wv", "bv").reshape(b, 1, h, hd)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0)
+        )
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))  # (B, H, 1, Tmax)
+        t_max = ck.shape[1]
+        visible = jnp.arange(t_max) <= pos  # non-causal decode is still
+        # causal in generation order: future cache rows are zeros.
+        scores = jnp.where(
+            visible[None, None, None, :], scores, jnp.float32(-1e30)
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, cv).reshape(b, 1, h * hd)
+        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        if self.use_bias:
+            out = out + params["bo"].astype(out.dtype)
+        return out, {"k": ck, "v": cv}
+
     def apply(self, params, state, x, *, train=False, rng=None):
         if self.dtype is not None:
             x = x.astype(self.dtype)
@@ -206,3 +252,19 @@ class PositionalEmbedding(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         t = x.shape[1]
         return x + params["table"][:t][None].astype(x.dtype), {}
+
+    decode_safe = True  # positional rows picked by ``pos``, not x.shape
+
+    def init_cache(self, params, batch, max_len, dtype):
+        if max_len > self.max_len:
+            raise ValueError(
+                f"generation length {max_len} exceeds positional table "
+                f"max_len {self.max_len}"
+            )
+        return {}
+
+    def decode(self, params, state, cache, x, *, pos):
+        row = jax.lax.dynamic_slice_in_dim(
+            params["table"], pos, 1, axis=0
+        )  # (1, D)
+        return x + row[None].astype(x.dtype), cache

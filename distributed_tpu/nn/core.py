@@ -59,6 +59,29 @@ class Layer:
         """Run the layer on a batched input. Returns (output, new_state)."""
         raise NotImplementedError
 
+    # -- incremental decode (KV-cache generation) ---------------------------
+    # True means apply() treats every (batch of) position(s) independently,
+    # so the default one-token decode below is exact. Layers that mix
+    # positions (attention, positional embeddings, scanned block stacks)
+    # either override decode() with a cached implementation or set this
+    # False to fail loudly.
+    decode_safe = True
+
+    def init_cache(self, params: Params, batch: int, max_len: int, dtype):
+        """Create this layer's decode cache (empty for stateless layers)."""
+        return {}
+
+    def decode(self, params: Params, state: State, cache, x, *, pos):
+        """One autoregressive step: x is (B, 1, ...), pos the (traced)
+        position index. Returns (output, new_cache)."""
+        if not self.decode_safe:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support incremental "
+                "decode (generation)"
+            )
+        out, _ = self.apply(params, state, x, train=False)
+        return out, cache
+
     # -- shared helpers -----------------------------------------------------
     def sharding_hints(self) -> Dict[str, str]:
         """Tensor-parallel roles for this layer's params: param name ->
@@ -168,6 +191,30 @@ class Sequential(Layer):
                 new_state[layer.name] = s
         return x, new_state
 
+    def init_cache(self, params, batch, max_len, dtype):
+        caches = {}
+        for layer in self.layers:
+            c = layer.init_cache(
+                params.get(layer.name, {}), batch, max_len, dtype
+            )
+            if c:
+                caches[layer.name] = c
+        return caches
+
+    def decode(self, params, state, cache, x, *, pos):
+        new_cache = dict(cache)
+        for layer in self.layers:
+            x, c = layer.decode(
+                params.get(layer.name, {}),
+                state.get(layer.name, {}),
+                cache.get(layer.name, {}),
+                x,
+                pos=pos,
+            )
+            if c:
+                new_cache[layer.name] = c
+        return x, new_cache
+
     def summary_lines(self, input_shape: Shape):
         """Keras-style summary rows: (name, output_shape, param_count)."""
         from ..utils.tree import tree_size
@@ -269,6 +316,38 @@ class Residual(Layer):
         if ss:
             new_state["shortcut"] = ss
         return self.activation(y + sc), new_state
+
+    def init_cache(self, params, batch, max_len, dtype):
+        caches = {}
+        c = self.main.init_cache(params.get("main", {}), batch, max_len, dtype)
+        if c:
+            caches["main"] = c
+        if self.shortcut is not None:
+            c = self.shortcut.init_cache(
+                params.get("shortcut", {}), batch, max_len, dtype
+            )
+            if c:
+                caches["shortcut"] = c
+        return caches
+
+    def decode(self, params, state, cache, x, *, pos):
+        y, cm = self.main.decode(
+            params.get("main", {}), state.get("main", {}),
+            cache.get("main", {}), x, pos=pos,
+        )
+        new_cache = dict(cache)
+        if cm:
+            new_cache["main"] = cm
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.decode(
+                params.get("shortcut", {}), state.get("shortcut", {}),
+                cache.get("shortcut", {}), x, pos=pos,
+            )
+            if cs:
+                new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self.activation(y + sc), new_cache
 
     def __repr__(self):
         return (

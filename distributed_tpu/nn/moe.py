@@ -200,3 +200,49 @@ class MoE(Layer):
         if squeeze:
             out = out[:, 0]
         return out, {"aux_loss": aux}
+
+    # ------------------------------------------------- incremental decode --
+    # apply() mixes positions through group capacity (tokens compete for
+    # expert slots), so the inherited default decode would be silently
+    # wrong. This override routes each token droplessly: capacity never
+    # binds for one token at inference, which matches apply() exactly
+    # whenever apply() dropped nothing, and is the standard serving
+    # behavior when it did.
+    decode_safe = True
+
+    def decode(self, params, state, cache, x, *, pos):
+        from . import activations
+
+        act = activations.get(self.activation)
+        b, t, d = x.shape  # t == 1
+        e, k = self.num_experts, self.top_k
+        flat = x.reshape(b * t, d)
+        logits = jnp.einsum(
+            "nd,de->ne", flat.astype(jnp.float32), params["router"],
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (N, k)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        )
+        # Per-expert combine weight: sum of the gates that chose it.
+        weight = jnp.einsum(
+            "nk,nke->ne", gate_vals,
+            jax.nn.one_hot(gate_idx, e, dtype=jnp.float32),
+        )  # (N, e)
+        compute_dtype = self.dtype or x.dtype
+        h = act(
+            jnp.einsum("nd,edh->neh", flat.astype(compute_dtype),
+                       params["w_in"].astype(compute_dtype))
+            + params["b_in"][None].astype(compute_dtype)
+        )
+        out_e = (
+            jnp.einsum("neh,ehd->ned", h,
+                       params["w_out"].astype(compute_dtype))
+            + params["b_out"][None].astype(compute_dtype)
+        )
+        out = jnp.einsum(
+            "ne,ned->nd", weight.astype(compute_dtype), out_e
+        )
+        return out.reshape(b, t, d).astype(x.dtype), cache

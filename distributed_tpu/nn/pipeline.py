@@ -72,6 +72,11 @@ class PipelinedBlocks(Layer):
     ``lax.scan`` — identical numerics, which is what the parity tests assert.
     """
 
+    # The scanned/piped stack has no per-block cache threading; generation
+    # from a pipelined LM must fail loudly, not silently drop attention
+    # history (Layer.decode's default would run the inner MHA uncached).
+    decode_safe = False
+
     def __init__(
         self,
         block_fn: Callable[[], Layer],

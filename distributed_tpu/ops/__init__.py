@@ -1,17 +1,20 @@
+import importlib
+
 from . import losses, metrics
 
-__all__ = ["losses", "metrics", "flash_attention", "ring_attention"]
+# Submodules are exported lazily BY MODULE (not by re-exported function):
+# a module attribute and a function of the same name would shadow each other
+# depending on import order (importing the submodule binds it on this
+# package, silently replacing a re-exported function). Call sites use
+# ops.flash_attention.flash_attention / ops.ring_attention.ring_attention.
+_LAZY_SUBMODULES = ("flash_attention", "ring_attention", "pallas_kernels")
+
+__all__ = ["losses", "metrics", *_LAZY_SUBMODULES]
 
 
 def __getattr__(name):
-    # Lazy: flash/ring attention import jax.experimental.pallas / shard_map
-    # machinery not needed by the common CNN paths.
-    if name == "flash_attention":
-        from .flash_attention import flash_attention
-
-        return flash_attention
-    if name == "ring_attention":
-        from .ring_attention import ring_attention
-
-        return ring_attention
+    # Lazy: these import jax.experimental.pallas / shard_map machinery not
+    # needed by the common CNN paths.
+    if name in _LAZY_SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

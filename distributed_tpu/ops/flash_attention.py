@@ -237,6 +237,26 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+_warned_backend = False
+
+
+def _dense_fallback(q, k, v, causal):
+    """Stock-XLA attention for backends with no Mosaic lowering: Pallas
+    interpret mode inside jit is orders of magnitude slower than the dense
+    einsums, so non-TPU accelerators (GPU) take this path with a warning
+    (CPU keeps interpret mode — that's the test configuration)."""
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(d)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+
 def flash_attention(
     q, k, v, *, causal: bool = False,
     block_q: int = 256, block_k: int = 512,
@@ -245,7 +265,22 @@ def flash_attention(
 
     q, k, v: (B, T, H, D) — same layout MultiHeadAttention produces.
     Returns (B, T, H, D) in q's dtype. Scores/softmax compute in float32.
+    On backends with neither a Mosaic lowering nor a test rationale for
+    interpret mode (anything but TPU/CPU), falls back to dense XLA attention
+    with a one-time warning.
     """
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        global _warned_backend
+        if not _warned_backend:
+            from ..utils import logging as dlog
+
+            dlog.warning(
+                f"flash_attention: no Mosaic lowering on backend "
+                f"{backend!r}; using dense XLA attention"
+            )
+            _warned_backend = True
+        return _dense_fallback(q, k, v, causal)
     b, t, h, d = q.shape
     fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
     rt = _round_up(t, 8)

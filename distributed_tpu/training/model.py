@@ -23,6 +23,7 @@ TPU-first internals (what changed under the hood):
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
@@ -98,6 +99,7 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
+        self._generate_fns = {}  # (shapes, sampling config) -> jitted scan
 
     # ------------------------------------------------------------------ build
     def build(self, input_shape: Sequence[int], seed: int = 0):
@@ -474,6 +476,83 @@ class Model:
             outs.append(out[:valid])
         return np.concatenate(outs, axis=0)
 
+    # --------------------------------------------------------------- generate
+    @staticmethod
+    def _sample_logits(logits, key, temperature, top_k):
+        logits = logits.astype(jnp.float32)
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / jnp.float32(temperature)
+        ).astype(jnp.int32)
+
+    def generate(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Autoregressive sampling from a token LM with a KV cache.
+
+        ``prompt``: (B, T_p) int tokens. Returns (B, T_p + max_new_tokens).
+        ``temperature=0`` is greedy argmax; ``top_k`` restricts sampling to
+        the k highest-probability tokens. The whole prefill + decode loop is
+        one ``lax.scan`` inside one jit: the prompt is teacher-forced through
+        the same cached step the sampled tokens use, so there is exactly one
+        compile and O(T) attention per step (nn layers' ``decode``/
+        ``init_cache``; not supported for pipelined stacks).
+
+        The reference has no generation surface at all (its only model is a
+        classifier CNN, /root/reference/README.md:58-68); this is part of
+        the LM tier the framework adds.
+        """
+        if not self.built:
+            raise RuntimeError("Model not built")
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 2:
+            raise ValueError(f"prompt must be (batch, tokens); got {prompt.shape}")
+        b, t_p = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        max_len = t_p + max_new_tokens
+        module, params, state = self.module, self.params, self.state
+        # Activation dtype for the cache: what the embedding emits.
+        probe = jax.eval_shape(
+            lambda p: module.apply(p, state, jnp.zeros((1, 1), jnp.int32))[0],
+            params,
+        )
+        cache = module.init_cache(params, b, max_len, probe.dtype)
+        padded = np.zeros((b, max_len), np.int32)
+        padded[:, :t_p] = prompt
+
+        # jit cache keyed by the static configuration: params/state/prompt/
+        # seed flow in as arguments, so repeat generate() calls with the
+        # same shapes reuse the compiled scan instead of re-tracing a fresh
+        # closure every time.
+        sig = (b, t_p, max_len, float(temperature), top_k)
+        run = self._generate_fns.get(sig)
+        if run is None:
+            run = jax.jit(
+                functools.partial(
+                    _generate_scan, module, t_p, max_len, temperature, top_k
+                )
+            )
+            self._generate_fns[sig] = run
+
+        toks = np.asarray(
+            jax.device_get(
+                run(params, state, cache, jnp.asarray(padded),
+                    jax.random.PRNGKey(seed))
+            )
+        )
+        return np.concatenate([prompt[:, :1].astype(np.int32), toks], axis=1)
+
     # ---------------------------------------------------------------- summary
     def summary(self):
         if self.input_shape is None:
@@ -491,3 +570,23 @@ class Model:
         if jax.process_index() == 0:
             print(text)
         return text
+
+
+def _generate_scan(module, t_p, max_len, temperature, top_k,
+                   params, state, cache, padded, key):
+    """Prefill + decode as one lax.scan (jitted per static config by
+    Model.generate): teacher-force tokens < t_p, sample afterwards."""
+
+    def step(carry, t):
+        cache, tok, key = carry
+        logits, cache = module.decode(params, state, cache, tok[:, None],
+                                      pos=t)
+        key, sub = jax.random.split(key)
+        sampled = Model._sample_logits(logits[:, 0], sub, temperature, top_k)
+        next_tok = jnp.where(t + 1 < t_p, padded[:, t + 1], sampled)
+        return (cache, next_tok, key), next_tok
+
+    _, toks = jax.lax.scan(
+        step, (cache, padded[:, 0], key), jnp.arange(max_len - 1)
+    )  # (max_len-1, B)
+    return toks.T
