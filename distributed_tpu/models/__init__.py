@@ -1,6 +1,7 @@
 from .cnn import cifar_cnn, mnist_cnn
 from .resnet import resnet, resnet18, resnet34, resnet50
 from .transformer import transformer_block, transformer_lm
+from .vit import vit, vit_base, vit_large, vit_small, vit_tiny
 
 __all__ = [
     "mnist_cnn",
@@ -11,4 +12,9 @@ __all__ = [
     "resnet50",
     "transformer_lm",
     "transformer_block",
+    "vit",
+    "vit_tiny",
+    "vit_small",
+    "vit_base",
+    "vit_large",
 ]

@@ -65,6 +65,7 @@ def transformer_lm(
     moe_experts: int = 0,
     moe_every: int = 2,
     pipeline: bool = False,
+    remat: bool = False,
     dtype=None,
 ) -> nn.Sequential:
     """Token-in, logits-out LM: (B, T) int32 -> (B, T, vocab).
@@ -76,6 +77,10 @@ def transformer_lm(
     pipeline over the 'pipe' mesh axis under ``DataPipelineParallel`` (and
     run as a weight-stacked scan otherwise); incompatible with MoE blocks
     (aux-loss state can't ride the microbatch schedule).
+    ``remat=True`` wraps every attention/FFN residual in ``nn.Remat`` —
+    backward recomputes block activations instead of holding them in HBM
+    (identical numerics and checkpoint paths, O(1)-blocks activation
+    memory).
     """
     d_ff = d_ff or 4 * d_model
     layers = [
@@ -85,22 +90,25 @@ def transformer_lm(
     if pipeline:
         if moe_experts:
             raise ValueError("pipeline=True does not support MoE blocks")
-        layers.append(
-            nn.PipelinedBlocks(
-                lambda: nn.Sequential(
-                    transformer_block(
-                        d_model, num_heads, d_ff, causal=causal, dtype=dtype
-                    )
-                ),
-                num_layers,
+
+        def make_block():
+            block = nn.Sequential(
+                transformer_block(
+                    d_model, num_heads, d_ff, causal=causal, dtype=dtype
+                )
             )
-        )
+            return nn.Remat(block) if remat else block
+
+        layers.append(nn.PipelinedBlocks(make_block, num_layers))
     else:
         for i in range(num_layers):
             moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0
-            layers += transformer_block(
+            block = transformer_block(
                 d_model, num_heads, d_ff, causal=causal, moe_experts=moe,
                 dtype=dtype,
             )
+            if remat:
+                block = [nn.Remat(residual) for residual in block]
+            layers += block
     layers += [nn.LayerNorm(), nn.Dense(vocab_size, dtype=dtype)]
     return nn.Sequential(layers, name="transformer_lm")

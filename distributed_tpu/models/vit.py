@@ -1,0 +1,100 @@
+"""Vision Transformer (ViT) family, NHWC, TPU-first.
+
+Not in the reference (its only model is the 2-conv MNIST CNN,
+/root/reference/README.md:58-68); this composes the framework's existing
+pieces — the strided-conv patchifier rides the MXU like any conv, the
+encoder reuses models.transformer.transformer_block, so Megatron TP hints
+(q/k/v + MLP-in column-sharded, projections row-sharded) and flash
+attention come along for free.
+
+Design notes:
+- Patch embedding = Conv2D(d_model, patch, strides=patch): one big matmul
+  per image, no gather/reshape gymnastics before the MXU.
+- Global-average-pool head (the ViT paper's GAP variant) instead of a CLS
+  token: no ragged concat, token count stays a clean H/p * W/p for the
+  sequence axis, and accuracy is equivalent at this scale.
+- Encoder blocks are non-causal; ``remat=True`` wraps each residual in
+  nn.Remat for O(1)-blocks activation memory.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn
+from .transformer import transformer_block
+
+_CONFIGS = {
+    # name: (num_layers, d_model, num_heads)
+    "tiny": (12, 192, 3),
+    "small": (12, 384, 6),
+    "base": (12, 768, 12),
+    "large": (24, 1024, 16),
+}
+
+
+def vit(
+    num_classes: int = 1000,
+    *,
+    image_size: int = 224,
+    patch_size: int = 16,
+    num_layers: int = 12,
+    d_model: int = 768,
+    num_heads: int = 12,
+    d_ff: Optional[int] = None,
+    remat: bool = False,
+    dtype=None,
+) -> nn.Sequential:
+    """(B, H, W, C) images -> (B, num_classes) logits."""
+    if image_size % patch_size:
+        raise ValueError(
+            f"image_size {image_size} not divisible by patch_size {patch_size}"
+        )
+    side = image_size // patch_size
+    n_tokens = side * side
+    d_ff = d_ff or 4 * d_model
+
+    layers = [
+        nn.Conv2D(d_model, patch_size, strides=patch_size, padding="valid",
+                  dtype=dtype, name="patch_embed"),
+        nn.Lambda(
+            lambda x: x.reshape(x.shape[0], -1, x.shape[-1]),
+            output_shape=(n_tokens, d_model),
+            name="patches_to_tokens",
+        ),
+        nn.PositionalEmbedding(n_tokens),
+    ]
+    for _ in range(num_layers):
+        block = transformer_block(
+            d_model, num_heads, d_ff, causal=False, dtype=dtype
+        )
+        if remat:
+            block = [nn.Remat(residual) for residual in block]
+        layers += block
+    layers += [
+        nn.LayerNorm(),
+        nn.Lambda(
+            lambda x: x.mean(axis=1), output_shape=(d_model,), name="gap"
+        ),
+        nn.Dense(num_classes, dtype=dtype),
+    ]
+    return nn.Sequential(layers, name="vit")
+
+
+def _named(size: str):
+    def make(num_classes: int = 1000, **kw) -> nn.Sequential:
+        num_layers, d_model, num_heads = _CONFIGS[size]
+        kw.setdefault("num_layers", num_layers)
+        kw.setdefault("d_model", d_model)
+        kw.setdefault("num_heads", num_heads)
+        return vit(num_classes, **kw)
+
+    make.__name__ = f"vit_{size}"
+    make.__doc__ = f"ViT-{size.capitalize()} ({_CONFIGS[size]})."
+    return make
+
+
+vit_tiny = _named("tiny")
+vit_small = _named("small")
+vit_base = _named("base")
+vit_large = _named("large")

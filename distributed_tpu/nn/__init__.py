@@ -1,6 +1,7 @@
 from .attention import MultiHeadAttention, PositionalEmbedding
 from .moe import MoE
 from .pipeline import PipelinedBlocks
+from .remat import Remat
 from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
     Activation,
@@ -36,4 +37,5 @@ __all__ = [
     "MoE",
     "PipelinedBlocks",
     "PositionalEmbedding",
+    "Remat",
 ]

@@ -162,6 +162,15 @@ class MultiHeadAttention(Layer):
     def decode(self, params, state, cache, x, *, pos):
         """One-token attention over the KV cache: x (B, 1, D), the new K/V
         row written at ``pos``, scores masked to positions <= pos."""
+        if not self.causal:
+            # Cached decode is causal by construction (future rows are
+            # zeros); a bidirectional model was trained attending both ways
+            # and would silently get different logits here.
+            raise NotImplementedError(
+                "incremental decode requires causal attention "
+                "(MultiHeadAttention(causal=True)); bidirectional models "
+                "have no autoregressive decode"
+            )
         if self.dtype is not None:
             x = x.astype(self.dtype)
         b = x.shape[0]
@@ -180,8 +189,7 @@ class MultiHeadAttention(Layer):
             "bqhd,bkhd->bhqk", q, ck, preferred_element_type=jnp.float32
         ) / jnp.sqrt(jnp.float32(hd))  # (B, H, 1, Tmax)
         t_max = ck.shape[1]
-        visible = jnp.arange(t_max) <= pos  # non-causal decode is still
-        # causal in generation order: future cache rows are zeros.
+        visible = jnp.arange(t_max) <= pos
         scores = jnp.where(
             visible[None, None, None, :], scores, jnp.float32(-1e30)
         )

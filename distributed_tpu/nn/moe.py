@@ -109,6 +109,21 @@ class MoE(Layer):
                 / self.num_experts) or 1
         return min(c, group)
 
+    def _route(self, tokens_f32, router):
+        """Shared routing math for apply() and decode(): softmax router
+        probs -> top-k choice -> renormalized gates. tokens_f32 is
+        (..., d) float32; returns (probs, gate_vals, gate_idx)."""
+        logits = jnp.einsum(
+            "...d,de->...e", tokens_f32, router,
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        )
+        return probs, gate_vals, gate_idx
+
     def apply(self, params, state, x, *, train=False, rng=None):
         from . import activations
 
@@ -133,18 +148,10 @@ class MoE(Layer):
         # (G, g) validity mask; pad tokens are excluded from dispatch (they
         # consume no capacity) and from the aux loss statistics.
         valid = (jnp.arange(n_pad) < n).astype(jnp.float32).reshape(ng, g)
-        logits = jnp.einsum(
-            "Gnd,de->Gne",
-            tokens.astype(jnp.float32),
-            params["router"],
-            preferred_element_type=jnp.float32,
-        )
-        probs = jax.nn.softmax(logits, axis=-1)  # (G, g, e)
-
-        # Top-k expert choice per token; renormalized gate weights.
-        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (G, g, k)
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+        # Router probs + top-k choice + renormalized gates (shared with
+        # decode()). probs: (G, g, e); gate_vals/gate_idx: (G, g, k).
+        probs, gate_vals, gate_idx = self._route(
+            tokens.astype(jnp.float32), params["router"]
         )
 
         # Position of each (token, choice) in its expert's per-group buffer;
@@ -217,15 +224,9 @@ class MoE(Layer):
         b, t, d = x.shape  # t == 1
         e, k = self.num_experts, self.top_k
         flat = x.reshape(b * t, d)
-        logits = jnp.einsum(
-            "nd,de->ne", flat.astype(jnp.float32), params["router"],
-            preferred_element_type=jnp.float32,
-        )
-        probs = jax.nn.softmax(logits, axis=-1)
-        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # (N, k)
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
-        )
+        _, gate_vals, gate_idx = self._route(
+            flat.astype(jnp.float32), params["router"]
+        )  # (N, k)
         # Per-expert combine weight: sum of the gates that chose it.
         weight = jnp.einsum(
             "nk,nke->ne", gate_vals,

@@ -100,6 +100,7 @@ class Model:
         self._eval_step = None
         self._predict_step = None
         self._generate_fns = {}  # (shapes, sampling config) -> jitted scan
+        self._decode_dtype = None  # cache dtype, memoized per build
 
     # ------------------------------------------------------------------ build
     def build(self, input_shape: Sequence[int], seed: int = 0):
@@ -117,6 +118,8 @@ class Model:
         if self.compiled:
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         self.built = True
+        self._decode_dtype = None  # re-derived on next generate()
+        self._generate_fns = {}
         return self
 
     def compile(
@@ -522,12 +525,18 @@ class Model:
             raise ValueError("max_new_tokens must be >= 1")
         max_len = t_p + max_new_tokens
         module, params, state = self.module, self.params, self.state
-        # Activation dtype for the cache: what the embedding emits.
-        probe = jax.eval_shape(
-            lambda p: module.apply(p, state, jnp.zeros((1, 1), jnp.int32))[0],
-            params,
-        )
-        cache = module.init_cache(params, b, max_len, probe.dtype)
+        if self._decode_dtype is None:
+            # Activation dtype for the KV cache, from an abstract trace of
+            # the forward pass (the logits dtype equals the activation
+            # dtype for these models). Memoized: per built model, not per
+            # generate() call.
+            self._decode_dtype = jax.eval_shape(
+                lambda p: module.apply(
+                    p, state, jnp.zeros((1, 1), jnp.int32)
+                )[0],
+                params,
+            ).dtype
+        cache = module.init_cache(params, b, max_len, self._decode_dtype)
         padded = np.zeros((b, max_len), np.int32)
         padded[:, :t_p] = prompt
 

@@ -122,6 +122,16 @@ def test_generate_learns_a_period_two_cycle():
     assert out[0].tolist() == expect, out[0].tolist()
 
 
+def test_noncausal_decode_raises():
+    """Bidirectional attention has no autoregressive decode; it must fail
+    loudly, not silently run causal (trained-vs-decoded mismatch)."""
+    mha = nn.MultiHeadAttention(2, causal=False)
+    params, _, _ = mha.init(jax.random.PRNGKey(0), (4, 16))
+    cache = mha.init_cache(params, 1, 4, jnp.float32)
+    with pytest.raises(NotImplementedError, match="causal"):
+        mha.decode(params, {}, cache, jnp.zeros((1, 1, 16)), pos=0)
+
+
 def test_generate_pipelined_lm_raises():
     model = dtpu.Model(_lm(pipeline=True))
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")

@@ -1,0 +1,200 @@
+"""nn.Remat (gradient checkpointing) and the ViT model family.
+
+Remat's contract is transparency: identical outputs, grads, param-tree
+paths, sharding hints and decode behavior — only the XLA schedule changes
+(a remat primitive appears in the jaxpr).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+def _block(remat):
+    inner = nn.Sequential(
+        [nn.LayerNorm(), nn.Dense(32, activation="gelu"), nn.Dense(16)],
+        name="main",
+    )
+    return nn.Remat(inner) if remat else inner
+
+
+class TestRemat:
+    def test_outputs_grads_and_tree_identical(self):
+        plain, wrapped = _block(False), _block(True)
+        params, state, _ = plain.init(jax.random.PRNGKey(0), (16,))
+        params_w, _, _ = wrapped.init(jax.random.PRNGKey(0), (16,))
+        assert jax.tree_util.tree_structure(params) == \
+            jax.tree_util.tree_structure(params_w)
+
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((4, 16)), jnp.float32
+        )
+
+        def loss_plain(p):
+            return jnp.sum(plain.apply(p, {}, x)[0] ** 2)
+
+        def loss_wrapped(p):
+            return jnp.sum(wrapped.apply(p, {}, x)[0] ** 2)
+
+        np.testing.assert_allclose(
+            loss_plain(params), loss_wrapped(params), rtol=1e-6
+        )
+        gp = jax.grad(loss_plain)(params)
+        gw = jax.grad(loss_wrapped)(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(gp), jax.tree_util.tree_leaves(gw)
+        ):
+            np.testing.assert_allclose(a, b, atol=1e-6, rtol=1e-5)
+
+    def test_remat_primitive_in_jaxpr(self):
+        wrapped = _block(True)
+        params, _, _ = wrapped.init(jax.random.PRNGKey(0), (16,))
+        x = jnp.zeros((2, 16))
+        jaxpr = jax.make_jaxpr(
+            lambda p: jax.grad(
+                lambda q: jnp.sum(wrapped.apply(q, {}, x)[0])
+            )(p)
+        )(params)
+        assert "remat" in str(jaxpr)
+
+    def test_transparent_name_and_hints(self):
+        inner = nn.Dense(8, shard="col")
+        wrapped = nn.Remat(inner)
+        assert wrapped.default_name() == inner.default_name()
+        assert wrapped.sharding_hints() == inner.sharding_hints()
+
+    def test_explicit_inner_name_survives_wrapping(self):
+        """Toggling remat must not change checkpoint paths — an explicitly
+        named layer keeps its name through the wrapper."""
+        plain = nn.Sequential([nn.Dense(8, name="head")])
+        wrapped = nn.Sequential([nn.Remat(nn.Dense(8, name="head"))])
+        p1, _, _ = plain.init(jax.random.PRNGKey(0), (4,))
+        p2, _, _ = wrapped.init(jax.random.PRNGKey(0), (4,))
+        assert set(p1) == set(p2) == {"head"}
+        # Duplicate-name detection still fires through the wrapper.
+        with pytest.raises(ValueError, match="Duplicate"):
+            nn.Sequential([
+                nn.Remat(nn.Dense(8, name="x")),
+                nn.Remat(nn.Dense(8, name="x")),
+            ])
+
+    def test_pipelined_remat_matches_plain_pipeline(self):
+        """transformer_lm(pipeline=True, remat=True) must train identically
+        to the un-remat pipelined model (remat only reschedules)."""
+        x = np.random.default_rng(3).integers(0, 32, (8, 8)).astype(np.int32)
+        y = np.random.default_rng(4).integers(0, 32, (8, 8)).astype(np.int32)
+        losses = []
+        for remat in (False, True):
+            m = dtpu.Model(
+                dtpu.models.transformer_lm(
+                    32, num_layers=2, d_model=16, num_heads=2, max_len=8,
+                    pipeline=True, remat=remat,
+                )
+            )
+            m.compile(optimizer=dtpu.optim.Adam(1e-3),
+                      loss="sparse_categorical_crossentropy")
+            losses.append(
+                m.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+                .history["loss"]
+            )
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
+
+    def test_lm_remat_training_parity(self):
+        """transformer_lm(remat=True) trains to the same losses as without
+        (same seed, same data) — remat must not perturb numerics."""
+        x = np.random.default_rng(0).integers(0, 32, (8, 12)).astype(np.int32)
+        y = np.random.default_rng(1).integers(0, 32, (8, 12)).astype(np.int32)
+        hists = []
+        for remat in (False, True):
+            m = dtpu.Model(
+                dtpu.models.transformer_lm(
+                    32, num_layers=2, d_model=16, num_heads=2, max_len=12,
+                    remat=remat,
+                )
+            )
+            m.compile(optimizer=dtpu.optim.Adam(1e-3),
+                      loss="sparse_categorical_crossentropy")
+            hists.append(
+                m.fit(x, y, batch_size=8, epochs=3, verbose=0, seed=0)
+                .history["loss"]
+            )
+        np.testing.assert_allclose(hists[0], hists[1], rtol=1e-5)
+
+    def test_remat_lm_generate_works(self):
+        m = dtpu.Model(
+            dtpu.models.transformer_lm(
+                32, num_layers=1, d_model=16, num_heads=2, max_len=16,
+                remat=True,
+            )
+        )
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.build((8,))
+        out = m.generate(np.array([[1, 2]], np.int32), 4, temperature=0.0)
+        assert out.shape == (1, 6)
+
+
+class TestViT:
+    def test_shapes_and_param_structure(self):
+        module = dtpu.models.vit(
+            10, image_size=32, patch_size=8, num_layers=2, d_model=32,
+            num_heads=4,
+        )
+        params, state, out = module.init(jax.random.PRNGKey(0), (32, 32, 3))
+        assert out == (10,)
+        x = jnp.zeros((2, 32, 32, 3))
+        logits, _ = module.apply(params, {}, x)
+        assert logits.shape == (2, 10)
+
+    def test_indivisible_patch_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            dtpu.models.vit(10, image_size=30, patch_size=16)
+
+    def test_named_sizes(self):
+        m = dtpu.models.vit_tiny(10, image_size=32, patch_size=16)
+        _, _, out = m.init(jax.random.PRNGKey(0), (32, 32, 3))
+        assert out == (10,)
+
+    def test_learns_separable_data(self):
+        x, y = dtpu.data.synthetic_images(256, (16, 16), 4, 0)
+        x = np.repeat(x[..., None], 3, axis=-1).astype(np.float32) / 255.0
+        model = dtpu.Model(
+            dtpu.models.vit(
+                4, image_size=16, patch_size=4, num_layers=2, d_model=32,
+                num_heads=4,
+            )
+        )
+        model.compile(optimizer=dtpu.optim.Adam(3e-3),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        hist = model.fit(x, y.astype(np.int32), batch_size=64, epochs=15,
+                         verbose=0)
+        assert hist.history["accuracy"][-1] > 0.8, hist.history["accuracy"][-3:]
+
+    def test_tp_hints_flow_from_blocks(self):
+        module = dtpu.models.vit(
+            10, image_size=32, patch_size=8, num_layers=1, d_model=32,
+            num_heads=4,
+        )
+        hints = module.sharding_hints()
+        flat = str(hints)
+        assert "col" in flat and "row" in flat  # Megatron roles present
+
+    def test_vit_under_tensor_parallel(self, devices):
+        strategy = dtpu.DataTensorParallel(devices=devices, model_parallel=2)
+        with strategy.scope():
+            model = dtpu.Model(
+                dtpu.models.vit(
+                    10, image_size=16, patch_size=4, num_layers=1,
+                    d_model=32, num_heads=4,
+                )
+            )
+            model.compile(optimizer=dtpu.optim.Adam(1e-3),
+                          loss="sparse_categorical_crossentropy")
+        x = np.zeros((8, 16, 16, 3), np.float32)
+        y = np.zeros((8,), np.int32)
+        hist = model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+        assert len(hist.history["loss"]) == 1
