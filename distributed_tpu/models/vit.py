@@ -57,9 +57,12 @@ def vit(
     layers = [
         nn.Conv2D(d_model, patch_size, strides=patch_size, padding="valid",
                   dtype=dtype, name="patch_embed"),
+        # No explicit output_shape: Lambda.init infers the token count from
+        # the real input shape, so building with images that don't match
+        # image_size fails loudly in PositionalEmbedding instead of
+        # producing a mis-sized positional table.
         nn.Lambda(
             lambda x: x.reshape(x.shape[0], -1, x.shape[-1]),
-            output_shape=(n_tokens, d_model),
             name="patches_to_tokens",
         ),
         nn.PositionalEmbedding(n_tokens),

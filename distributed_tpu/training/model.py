@@ -127,9 +127,20 @@ class Model:
         optimizer="sgd",
         loss="sparse_categorical_crossentropy",
         metrics: Iterable = ("accuracy",),
+        grad_clip: Optional[float] = None,
         **optimizer_kwargs,
     ):
+        """``grad_clip``: global-norm gradient clipping applied before the
+        optimizer update (optax.clip_by_global_norm); the norm reduction
+        happens inside the jitted step, so under data parallelism it clips
+        the *global* (all-reduced) gradient, not per-replica shards."""
         self.tx = optim.get(optimizer, **optimizer_kwargs)
+        if grad_clip is not None:
+            if grad_clip <= 0:
+                raise ValueError(f"grad_clip must be > 0, got {grad_clip}")
+            self.tx = optax.chain(
+                optax.clip_by_global_norm(float(grad_clip)), self.tx
+            )
         self.loss_fn = losses_lib.get(loss)
         self.metric_fns = [(metrics_lib.name_of(m), metrics_lib.get(m)) for m in metrics]
         self.compiled = True

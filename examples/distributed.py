@@ -1,0 +1,54 @@
+"""Multi-worker data-parallel trainer — the same script on every host.
+
+Mirror of the reference's distributed Python trainer
+(/root/reference/README.md:318-392), preserving its core UX contract:
+local -> distributed is a ~6-line diff (SURVEY.md §3.4). The TF_CONFIG
+env JSON is replaced by DTPU_CONFIG with the identical schema; set it
+before running (or let the launcher inject it):
+
+    export DTPU_CONFIG='{"cluster": {"worker": ["10.0.0.1:10087",
+      "10.0.0.2:10088", "10.0.0.3:10089", "10.0.0.4:10090"]},
+      "task": {"type": "worker", "index": 0}}'   # index differs per host
+
+Or gang-launch all workers at once (replaces the reference's four manual
+sessions and its Spark-barrier variant, README.md:170-224):
+
+    python -m distributed_tpu.launch --num-workers 4 examples/distributed.py
+"""
+
+import numpy as np
+
+import distributed_tpu as dtpu
+
+spec = dtpu.cluster.initialize()  # reads DTPU_CONFIG / TF_CONFIG / pod env
+print(f"worker {spec.index}/{spec.num_processes} up; chief={spec.is_chief}")
+
+x_train, y_train = dtpu.data.load_mnist("train")
+x_train = np.asarray(x_train, np.float32)
+if x_train.ndim == 3:
+    x_train = x_train[..., None]
+if x_train.max() > 1.5:
+    x_train = x_train / 255.0
+y_train = np.asarray(y_train, np.int32)
+
+# The ~6-line diff from local: strategy + scope + global batch.
+strategy = dtpu.DataParallel()
+with strategy.scope():
+    model = dtpu.Model(dtpu.models.mnist_cnn())
+    model.compile(
+        optimizer=dtpu.optim.SGD(0.001),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+
+# Global batch = 64 x replicas, the reference's scaling rule
+# (README.md:124-125, 366-367).
+global_batch = 64 * strategy.num_replicas_in_sync
+history = model.fit(x_train, y_train, batch_size=global_batch, epochs=3,
+                    steps_per_epoch=5)
+
+if spec.is_chief:
+    # Rank-0 export, the reference's model-retrieval path
+    # (README.md:236-247) plus the restore capability it lacked.
+    dtpu.export_hdf5("model.h5", model.params)
+    print("chief wrote model.h5")

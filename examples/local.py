@@ -1,0 +1,32 @@
+"""Local single-device smoke-test trainer.
+
+Mirror of the reference's Python local trainer
+(/root/reference/README.md:281-312) — the per-node validation run its
+workflow prescribes before going distributed ("make sure the workers are
+properly configured by training a local model first", README.md:25).
+Same CNN, same compile settings, same fit(batch 64, 3 epochs, 5 steps).
+"""
+
+import numpy as np
+
+import distributed_tpu as dtpu
+
+# Load + reshape + scale, the reference's exact preprocessing
+# (README.md:286-290): (N, 28, 28) -> (N, 28, 28, 1), /255.
+x_train, y_train = dtpu.data.load_mnist("train")
+x_train = np.asarray(x_train, np.float32)
+if x_train.ndim == 3:
+    x_train = x_train[..., None]
+if x_train.max() > 1.5:
+    x_train = x_train / 255.0
+y_train = np.asarray(y_train, np.int32)
+
+model = dtpu.Model(dtpu.models.mnist_cnn())
+model.compile(
+    optimizer=dtpu.optim.SGD(0.001),
+    loss="sparse_categorical_crossentropy",
+    metrics=["accuracy"],
+)
+history = model.fit(x_train, y_train, batch_size=64, epochs=3,
+                    steps_per_epoch=5)
+print({k: [round(v, 4) for v in vs] for k, vs in history.history.items()})

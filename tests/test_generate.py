@@ -140,6 +140,57 @@ def test_generate_pipelined_lm_raises():
         model.generate(np.array([[1, 2]], np.int32), 4)
 
 
+def test_generate_under_tensor_parallel_matches_single_device(devices):
+    """Generation must work with Megatron-sharded params and produce the
+    same greedy tokens as the unsharded model."""
+    x = np.random.default_rng(5).integers(0, 32, (8, 12)).astype(np.int32)
+    y = np.random.default_rng(6).integers(0, 32, (8, 12)).astype(np.int32)
+    prompt = np.array([[3, 1, 4]], np.int32)
+
+    single = dtpu.Model(_lm(max_len=16))
+    single.compile(optimizer=dtpu.optim.Adam(1e-3),
+                   loss="sparse_categorical_crossentropy")
+    single.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+    want = single.generate(prompt, 8, temperature=0.0)
+
+    strategy = dtpu.DataTensorParallel(devices=devices, model_parallel=2)
+    with strategy.scope():
+        tp = dtpu.Model(_lm(max_len=16))
+        tp.compile(optimizer=dtpu.optim.Adam(1e-3),
+                   loss="sparse_categorical_crossentropy")
+    tp.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+    got = tp.generate(prompt, 8, temperature=0.0)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_compile_grad_clip_bounds_updates():
+    """grad_clip must cap the global gradient norm actually applied."""
+    import jax
+
+    x = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+    y = (np.random.default_rng(1).integers(0, 2, (16,))).astype(np.int32)
+    module = nn.Sequential([nn.Dense(2)])
+    m = dtpu.Model(module)
+    # Huge LR + tiny clip: without clipping the params would blow up.
+    m.compile(optimizer=dtpu.optim.SGD(1.0), grad_clip=1e-3,
+              loss="sparse_categorical_crossentropy")
+    m.build((4,))
+    before = jax.tree_util.tree_map(np.asarray, m.params)
+    m.fit(x, y, batch_size=16, epochs=1, verbose=0)
+    after = jax.tree_util.tree_map(np.asarray, m.params)
+    deltas = [
+        np.linalg.norm(b - a) ** 2
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)
+        )
+    ]
+    total = float(np.sqrt(sum(deltas)))
+    assert total <= 1e-3 * 1.0 + 1e-6, total  # lr * clip
+
+    with pytest.raises(ValueError, match="grad_clip"):
+        dtpu.Model(nn.Sequential([nn.Dense(2)])).compile(grad_clip=-1.0)
+
+
 def test_generate_beyond_positional_table_raises():
     model = dtpu.Model(_lm(max_len=8))
     model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
