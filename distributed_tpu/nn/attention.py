@@ -95,9 +95,10 @@ class MultiHeadAttention(Layer):
         return hints
 
     def _ring_config(self):
-        """(mesh, batch_axis) when sequence-parallel ring attention should
-        run, else None. Reads the ambient strategy at trace time (Model
-        enters its strategy scope around step tracing)."""
+        """(mesh, batch_axis, mode) when sequence-parallel attention should
+        run ('ring' or 'ulysses' per the strategy), else None. Reads the
+        ambient strategy at trace time (Model enters its strategy scope
+        around step tracing)."""
         if self.ring_axis is None:
             return None
         from ..parallel.strategy import current_strategy
@@ -111,7 +112,40 @@ class MultiHeadAttention(Layer):
         batch_axis = getattr(strat, "axis", None)
         if batch_axis not in mesh.axis_names:
             batch_axis = None
-        return mesh, batch_axis
+        mode = getattr(strat, "seq_attention", "ring")
+        return mesh, batch_axis, mode
+
+    def _ulysses_attention(self, q, k, v, mesh, batch_axis):
+        """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: two
+        sharding constraints reshard (B, T/s, H, d) -> (B, T, H/s, d) and
+        back — GSPMD lowers each to one all-to-all over the seq axis — so
+        every device runs full-sequence attention for its head slice. One
+        collective pair per layer vs ring's n-1 ppermutes; requires
+        num_heads divisible by the seq-axis size."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        seq_axis = self.ring_axis
+        n_seq = int(mesh.shape[seq_axis])
+        h = self.num_heads
+        if h % n_seq:
+            raise ValueError(
+                f"Ulysses attention shards heads over the {seq_axis!r} "
+                f"axis: num_heads {h} not divisible by its size {n_seq}"
+            )
+        head_sh = NamedSharding(mesh, P(batch_axis, None, seq_axis, None))
+        seq_sh = NamedSharding(mesh, P(batch_axis, seq_axis, None, None))
+        wsc = jax.lax.with_sharding_constraint
+        q, k, v = (wsc(a, head_sh) for a in (q, k, v))
+        b, t, _, hd = q.shape
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(jnp.float32(hd))
+        if self.causal:
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        return wsc(ctx, seq_sh)
 
     def _use_flash(self, t: int) -> bool:
         if self.flash is True:
@@ -211,16 +245,19 @@ class MultiHeadAttention(Layer):
         v = self._proj(params, x, "wv", "bv").reshape(b, t, h, hd)
         ring = self._ring_config()
         if ring is not None:
-            from ..ops.ring_attention import ring_attention
+            mesh, batch_axis, mode = ring
+            if mode == "ulysses":
+                ctx = self._ulysses_attention(q, k, v, mesh, batch_axis)
+            else:
+                from ..ops.ring_attention import ring_attention
 
-            mesh, batch_axis = ring
-            ctx = ring_attention(
-                q, k, v,
-                mesh=mesh,
-                seq_axis=self.ring_axis,
-                batch_axis=batch_axis,
-                causal=self.causal,
-            )
+                ctx = ring_attention(
+                    q, k, v,
+                    mesh=mesh,
+                    seq_axis=self.ring_axis,
+                    batch_axis=batch_axis,
+                    causal=self.causal,
+                )
         elif self._use_flash(t):
             ctx = self._flash_call(q, k, v)
         else:

@@ -66,13 +66,20 @@ def ambient_mesh() -> Tuple[Optional[Mesh], Optional[str], Optional[str]]:
 
 
 def shard_rows(fn, arrays: Sequence, in_specs: Sequence[PartitionSpec],
-               out_spec: PartitionSpec):
+               out_spec: PartitionSpec, *, allowed_axes=None):
     """Apply fn(*arrays) under shard_map over the ambient mesh when safe
-    (see module docstring), else call it plainly."""
+    (see module docstring), else call it plainly.
+
+    ``allowed_axes``: override the default {batch, model} axis allowlist —
+    for callers that deliberately shard over another axis (e.g. Ulysses
+    attention sharding heads over 'seq') and have already validated it."""
     mesh, batch_axis, model_axis = ambient_mesh()
     if mesh is None:
         return fn(*arrays)
-    allowed = {batch_axis, model_axis, None}
+    if allowed_axes is not None:
+        allowed = set(allowed_axes) | {None}
+    else:
+        allowed = {batch_axis, model_axis, None}
     for name in mesh.axis_names:
         if int(mesh.shape[name]) > 1 and name not in allowed:
             return fn(*arrays)

@@ -472,7 +472,18 @@ class DataSeqParallel(DataParallel):
         seq_parallel: int = 2,
         axis: str = "data",
         seq_axis: str = "seq",
+        attention: str = "ring",
     ):
+        """``attention``: how MultiHeadAttention runs over the seq axis —
+        "ring" (K/V blocks rotate neighbor-to-neighbor via ppermute; memory
+        O(T/n) everywhere) or "ulysses" (two all-to-alls reshard tokens ->
+        heads so each device computes full-T attention for H/n heads; one
+        collective pair per layer instead of n-1 permutes, but needs
+        num_heads divisible by seq_parallel)."""
+        if attention not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention must be 'ring' or 'ulysses', got {attention!r}"
+            )
         if mesh is None:
             ndev = len(devices or jax.devices())
             if ndev % seq_parallel:
@@ -488,6 +499,7 @@ class DataSeqParallel(DataParallel):
         if seq_axis not in mesh.axis_names:
             raise ValueError(f"Mesh {mesh.axis_names} has no axis {seq_axis!r}")
         self.seq_axis = seq_axis
+        self.seq_attention = attention
 
     def batch_sharding(self):
         # Rank-dependent: applied per-leaf in put_batch.
