@@ -184,18 +184,25 @@ def load_mnist(
     normalize: bool = True,
     data_dir: Optional[str] = None,
     synthetic_ok: bool = True,
+    force_synthetic: bool = False,
     synthetic_train_n: int = 60000,
     synthetic_test_n: int = 10000,
 ) -> Arrays:
-    dirs = _search_dirs(data_dir)
-    got = _try_npz(dirs, ["mnist.npz"], split) or _try_idx(
-        dirs, ["mnist", "MNIST/raw", ""], split
-    )
-    if got is None:
-        if not synthetic_ok:
+    # force_synthetic exists so a caller that needs BOTH splits from the
+    # same source (e.g. the convergence bench) can't end up training on a
+    # cached real split and evaluating on a synthetic one when only one
+    # split file is present on the machine.
+    got = None
+    if not force_synthetic:
+        dirs = _search_dirs(data_dir)
+        got = _try_npz(dirs, ["mnist.npz"], split) or _try_idx(
+            dirs, ["mnist", "MNIST/raw", ""], split
+        )
+        if got is None and not synthetic_ok:
             raise FileNotFoundError(
                 "MNIST not found in " + ", ".join(map(str, dirs)) + " and synthetic_ok=False"
             )
+    if got is None:
         got = _synthetic_split(split, (28, 28), 10, synthetic_train_n, synthetic_test_n, 1234)
     return _finalize(*got, normalize=normalize, channels=1)
 

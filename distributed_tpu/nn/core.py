@@ -358,6 +358,10 @@ class Residual(Layer):
 class Lambda(Layer):
     """Wrap an arbitrary stateless function ``fn(x) -> y``."""
 
+    # The wrapped fn is opaque — it may mix positions (e.g. a reduction
+    # over the time axis), so one-token decode cannot be assumed exact.
+    decode_safe = False
+
     def __init__(self, fn, output_shape=None, name=None):
         super().__init__(name)
         self.fn = fn

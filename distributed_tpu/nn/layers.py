@@ -39,6 +39,11 @@ def _conv_out(size: int, k: int, s: int, padding: str) -> int:
 class Conv2D(Layer):
     """2-D convolution over NHWC inputs (kernel laid out HWIO for XLA)."""
 
+    # Convolution mixes neighbouring positions, so the inherited one-token
+    # decode would be silently wrong for a sequence model that routes time
+    # through a spatial axis; fail loudly instead.
+    decode_safe = False
+
     def __init__(
         self,
         filters: int,
@@ -151,6 +156,8 @@ class Dense(Layer):
 
 
 class Flatten(Layer):
+    decode_safe = False  # collapses all non-batch axes, including time
+
     def init(self, key, input_shape: Shape):
         out = 1
         for d in input_shape:
@@ -174,6 +181,8 @@ class Activation(Layer):
 
 
 class _Pool2D(Layer):
+    decode_safe = False  # pooling windows span positions
+
     def __init__(self, pool_size: IntOr2 = 2, strides: Optional[IntOr2] = None, padding="valid", name=None):
         super().__init__(name)
         self.pool_size = _pair(pool_size)
@@ -230,6 +239,8 @@ class AvgPool2D(_Pool2D):
 
 
 class GlobalAvgPool2D(Layer):
+    decode_safe = False  # reduces over spatial/temporal axes
+
     def init(self, key, input_shape: Shape):
         return {}, {}, (input_shape[-1],)
 

@@ -78,6 +78,9 @@ def _index_stream(
 class Model:
     """A trainable wrapper around a ``Layer`` (usually a ``Sequential``)."""
 
+    # Bound on retained generate() compilations (LRU evicted beyond this).
+    _GENERATE_CACHE_MAX = 16
+
     def __init__(self, module: Layer, name: Optional[str] = None):
         if module.name is None:
             module.name = module.default_name()
@@ -99,7 +102,7 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._predict_step = None
-        self._generate_fns = {}  # (shapes, sampling config) -> jitted scan
+        self._generate_fns = {}  # (shapes, sampling config) -> jitted scan (LRU)
         self._decode_dtype = None  # cache dtype, memoized per build
 
     # ------------------------------------------------------------------ build
@@ -495,7 +498,8 @@ class Model:
     def _sample_logits(logits, key, temperature, top_k):
         logits = logits.astype(jnp.float32)
         if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            k = min(int(top_k), logits.shape[-1])
+            kth = jax.lax.top_k(logits, k)[0][:, -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -532,9 +536,21 @@ class Model:
         if prompt.ndim != 2:
             raise ValueError(f"prompt must be (batch, tokens); got {prompt.shape}")
         b, t_p = prompt.shape
+        if t_p < 1:
+            raise ValueError(
+                "prompt must contain at least one token (the decode scan is "
+                f"seeded from prompt[:, 0]); got shape {prompt.shape}"
+            )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if top_k is not None and int(top_k) < 1:
+            raise ValueError(f"top_k must be >= 1; got {top_k}")
         max_len = t_p + max_new_tokens
+        # Bucket the scan length (multiple of 64) so serving loops with
+        # naturally varying prompt lengths reuse a handful of compilations
+        # instead of one per exact (t_p, max_len) pair; the prompt length
+        # itself flows in as a dynamic argument to the teacher-forcing mask.
+        bucket = max(64, -(-max_len // 64) * 64)
         module, params, state = self.module, self.params, self.state
         if self._decode_dtype is None:
             # Activation dtype for the KV cache, from an abstract trace of
@@ -547,31 +563,44 @@ class Model:
                 )[0],
                 params,
             ).dtype
-        cache = module.init_cache(params, b, max_len, self._decode_dtype)
-        padded = np.zeros((b, max_len), np.int32)
+        try:
+            cache = module.init_cache(params, b, bucket, self._decode_dtype)
+        except ValueError:
+            # Bucketed length exceeds the model's capacity (e.g. a learned
+            # positional table shorter than the bucket): fall back to the
+            # exact requested length.
+            bucket = max_len
+            cache = module.init_cache(params, b, bucket, self._decode_dtype)
+        padded = np.zeros((b, bucket), np.int32)
         padded[:, :t_p] = prompt
 
         # jit cache keyed by the static configuration: params/state/prompt/
-        # seed flow in as arguments, so repeat generate() calls with the
-        # same shapes reuse the compiled scan instead of re-tracing a fresh
-        # closure every time.
-        sig = (b, t_p, max_len, float(temperature), top_k)
-        run = self._generate_fns.get(sig)
+        # seed/prompt-length flow in as arguments, so repeat generate()
+        # calls with the same bucketed shapes reuse the compiled scan. The
+        # cache is LRU-bounded so a long-lived serving loop cannot retain
+        # unbounded compilations.
+        sig = (b, bucket, float(temperature), top_k)
+        run = self._generate_fns.pop(sig, None)
         if run is None:
+            while len(self._generate_fns) >= self._GENERATE_CACHE_MAX:
+                self._generate_fns.pop(next(iter(self._generate_fns)))
             run = jax.jit(
                 functools.partial(
-                    _generate_scan, module, t_p, max_len, temperature, top_k
+                    _generate_scan, module, bucket, temperature, top_k
                 )
             )
-            self._generate_fns[sig] = run
+        self._generate_fns[sig] = run  # (re-)insert as most recent
 
         toks = np.asarray(
             jax.device_get(
                 run(params, state, cache, jnp.asarray(padded),
+                    jnp.int32(t_p), jnp.int32(max_len - 1),
                     jax.random.PRNGKey(seed))
             )
         )
-        return np.concatenate([prompt[:, :1].astype(np.int32), toks], axis=1)
+        return np.concatenate(
+            [prompt[:, :1].astype(np.int32), toks[:, : max_len - 1]], axis=1
+        )
 
     # ---------------------------------------------------------------- summary
     def summary(self):
@@ -592,21 +621,33 @@ class Model:
         return text
 
 
-def _generate_scan(module, t_p, max_len, temperature, top_k,
-                   params, state, cache, padded, key):
+def _generate_scan(module, bucket, temperature, top_k,
+                   params, state, cache, padded, t_p, n_steps, key):
     """Prefill + decode as one lax.scan (jitted per static config by
-    Model.generate): teacher-force tokens < t_p, sample afterwards."""
+    Model.generate): teacher-force tokens < t_p (a dynamic scalar, so
+    prompt length never forces a recompile), sample afterwards. The scan
+    spans the full bucketed length, but iterations past ``n_steps``
+    (= requested max_len - 1, also dynamic) take a no-op ``lax.cond``
+    branch, so runtime decode cost tracks the requested length, not the
+    bucket. The caller slices off the dead tail."""
 
     def step(carry, t):
-        cache, tok, key = carry
-        logits, cache = module.decode(params, state, cache, tok[:, None],
-                                      pos=t)
-        key, sub = jax.random.split(key)
-        sampled = Model._sample_logits(logits[:, 0], sub, temperature, top_k)
-        next_tok = jnp.where(t + 1 < t_p, padded[:, t + 1], sampled)
-        return (cache, next_tok, key), next_tok
+        def live(carry):
+            cache, tok, key = carry
+            logits, cache = module.decode(params, state, cache, tok[:, None],
+                                          pos=t)
+            key, sub = jax.random.split(key)
+            sampled = Model._sample_logits(logits[:, 0], sub, temperature,
+                                           top_k)
+            next_tok = jnp.where(t + 1 < t_p, padded[:, t + 1], sampled)
+            return (cache, next_tok, key), next_tok
+
+        def dead(carry):
+            return carry, carry[1]
+
+        return jax.lax.cond(t < n_steps, live, dead, carry)
 
     _, toks = jax.lax.scan(
-        step, (cache, padded[:, 0], key), jnp.arange(max_len - 1)
-    )  # (max_len-1, B)
+        step, (cache, padded[:, 0], key), jnp.arange(bucket - 1)
+    )  # (bucket-1, B)
     return toks.T

@@ -25,6 +25,18 @@ def test_bench_mnist_smoke():
     )
 
 
+def test_bench_convergence_smoke():
+    """The north-star mode: small-set convergence with a generous target so
+    the smoke stays fast; the real 60k/0.98 run happens on the chip."""
+    out = bench.bench_convergence(
+        batch=64, max_epochs=10, target=0.9, train_n=2048, test_n=256
+    )
+    assert out["accuracy"] >= 0.9, out
+    assert out["seconds_to_target"] is not None
+    assert out["epochs_to_target"] >= 1
+    assert "synthetic" in out["data"] or "mnist" in out["data"]
+
+
 def test_bench_resnet50_smoke():
     # Tiny resolution keeps CPU conv time sane; depth stays 50 so the real
     # block structure (bottleneck, projection shortcuts) compiles.
@@ -56,6 +68,7 @@ def test_bench_output_contract(monkeypatch, capsys):
         lambda **kw: {"metric": "m", "value": 1.0, "unit": "steps/s",
                       "vs_baseline": 2.0},
     )
+    monkeypatch.setattr(bench, "bench_convergence", lambda **kw: {"metric": "c"})
     monkeypatch.setattr(bench, "bench_resnet50", lambda **kw: {"metric": "r"})
     monkeypatch.setattr(bench, "bench_transformer_lm",
                         lambda **kw: {"metric": "t"})
@@ -64,5 +77,5 @@ def test_bench_output_contract(monkeypatch, capsys):
     assert len(lines) == 1
     rec = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
-    assert [e["metric"] for e in rec["extra"]] == ["r", "t"]
+    assert [e["metric"] for e in rec["extra"]] == ["c", "r", "t"]
     assert "device" in rec

@@ -197,3 +197,35 @@ def test_generate_beyond_positional_table_raises():
     model.build((8,))
     with pytest.raises(ValueError, match="max_len"):
         model.generate(np.array([[1, 2, 3, 4]], np.int32), 16)
+
+
+def test_generate_bucketing_reuses_compilation_across_prompt_lengths():
+    """Varying prompt length within one 64-token bucket must not add a new
+    compiled scan (prompt length is a dynamic argument; the jit cache is
+    keyed on the bucketed length only) and the cache is LRU-bounded."""
+    model = dtpu.Model(_lm(max_len=64))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    p3 = np.array([[1, 2, 3]], np.int32)
+    p5 = np.array([[1, 2, 3, 4, 5]], np.int32)
+    model.generate(p3, 8, temperature=0.0)
+    n_compiled = len(model._generate_fns)
+    out5 = model.generate(p5, 8, temperature=0.0)
+    assert len(model._generate_fns) == n_compiled  # same bucket, no retrace
+    assert out5.shape == (1, 13)
+    np.testing.assert_array_equal(out5[:, :5], p5)
+    assert len(model._generate_fns) <= dtpu.Model._GENERATE_CACHE_MAX
+
+
+def test_generate_top_k_clamped_to_vocab():
+    """top_k >= vocab must behave as plain sampling, not crash at trace
+    time (round-2 advisor finding on the out-of-bounds sort index)."""
+    model = dtpu.Model(_lm(vocab=32))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    prompt = np.array([[1, 2]], np.int32)
+    a = model.generate(prompt, 4, temperature=1.0, top_k=32, seed=1)
+    b = model.generate(prompt, 4, temperature=1.0, top_k=1000, seed=1)
+    np.testing.assert_array_equal(a, b)  # both unrestricted
+    with pytest.raises(ValueError, match="top_k"):
+        model.generate(prompt, 4, top_k=0)
