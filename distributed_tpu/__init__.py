@@ -26,7 +26,7 @@ Quickstart (the reference's local->distributed 6-line-diff contract):
 """
 
 from . import cluster, data, models, nn, ops, optim, parallel, utils
-from .checkpoint import Checkpointer, export_hdf5, import_hdf5
+from .checkpoint import Checkpointer, ShardedCheckpointer, export_hdf5, import_hdf5
 from .training import callbacks
 from .ops import losses, metrics
 from .parallel.mesh import make_mesh
@@ -61,6 +61,7 @@ __all__ = [
     "current_strategy",
     "make_mesh",
     "Checkpointer",
+    "ShardedCheckpointer",
     "export_hdf5",
     "import_hdf5",
     "nn",

@@ -7,9 +7,11 @@ from .core import (
     load_npz,
     save_npz,
 )
+from .sharded import ShardedCheckpointer
 
 __all__ = [
     "Checkpointer",
+    "ShardedCheckpointer",
     "save_npz",
     "load_npz",
     "export_hdf5",

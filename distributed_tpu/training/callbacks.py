@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import numpy as np
 
-from ..checkpoint import Checkpointer
+from ..checkpoint import Checkpointer, ShardedCheckpointer
 from ..utils import logging as dlog
 
 
@@ -57,8 +57,12 @@ class ModelCheckpoint(Callback):
     """
 
     def __init__(self, directory, *, save_freq="epoch", keep: int = 3,
-                 restore: bool = False):
-        self.ckpt = Checkpointer(directory, keep=keep)
+                 restore: bool = False, sharded: bool = False):
+        # sharded=True switches to the per-process ShardedCheckpointer
+        # (requires a directory shared across hosts; hosts only touch their
+        # own shards — the right format for FSDP/TP-scale models).
+        cls = ShardedCheckpointer if sharded else Checkpointer
+        self.ckpt = cls(directory, keep=keep)
         if save_freq != "epoch" and not (
             isinstance(save_freq, int) and save_freq > 0
         ):

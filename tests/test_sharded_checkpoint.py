@@ -1,0 +1,181 @@
+"""ShardedCheckpointer: per-process shard files + manifest, restore under a
+different mesh shape, and the no-full-host-array guarantee (VERDICT round 2,
+item 3 — the npz Checkpointer gathers O(total params) per host, which is the
+wrong design for FSDP-scale models)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.checkpoint.sharded import _block_key, _parse_key
+
+
+def _data(n=64):
+    x, y = dtpu.data.synthetic_images(n, (28, 28), 10, seed=3)
+    return x[..., None].astype(np.float32) / 255.0, y
+
+
+def _fsdp_model(devices=None):
+    strategy = dtpu.FullyShardedDataParallel()
+    with strategy.scope():
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+        m.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    return m
+
+
+def test_key_roundtrip():
+    k = _block_key("params/dense/kernel", (128, 0), (128, 64))
+    assert _parse_key(k) == ("params/dense/kernel", (128, 0), (128, 64))
+    k = _block_key("params/bias", (), ())  # scalar leaf
+    assert _parse_key(k) == ("params/bias", (), ())
+
+
+class TestShardedRoundTrip:
+    def test_fsdp_roundtrip_no_full_host_array(self, devices, tmp_path):
+        x, y = _data()
+        m = _fsdp_model()
+        m.fit(x, y, batch_size=32, epochs=1, verbose=0)
+        before = m.evaluate(x, y, batch_size=32, verbose=0)
+
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m)
+        # The dense1 kernel (5408, 64) f32 shards 8 ways: the largest block
+        # any host touched must be its shard size, NOT its full size — the
+        # format's whole reason to exist.
+        dense_full = 5408 * 64 * 4
+        assert ck.last_max_block_bytes < dense_full
+        assert ck.last_max_block_bytes >= dense_full // 8
+
+        m2 = _fsdp_model()
+        m2.build((28, 28, 1))
+        step = ck.restore_into(m2)
+        assert step == m.step
+        assert ck.last_max_block_bytes < dense_full  # restore side too
+        after = m2.evaluate(x, y, batch_size=32, verbose=0)
+        assert before == after
+        # params bit-identical, shardings preserved
+        for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                        jax.tree_util.tree_leaves(m2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert a.sharding.is_equivalent_to(b.sharding, a.ndim)
+        # optimizer momentum restored too (bit-identical training continues)
+        for a, b in zip(jax.tree_util.tree_leaves(m.opt_state),
+                        jax.tree_util.tree_leaves(m2.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_restore_across_changed_mesh(self, devices, tmp_path):
+        """Save under FSDP(8), restore under plain DP (replicated params):
+        block reassembly reshards on read, so the mesh/axis layout at
+        restore time need not match the one at save time."""
+        x, y = _data()
+        m = _fsdp_model()
+        m.fit(x, y, batch_size=32, epochs=1, verbose=0)
+        before = m.evaluate(x, y, batch_size=32, verbose=0)
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m)
+
+        with dtpu.DataParallel().scope():
+            m2 = dtpu.Model(dtpu.models.mnist_cnn())
+            m2.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                       loss="sparse_categorical_crossentropy",
+                       metrics=["accuracy"])
+        m2.build((28, 28, 1))
+        ck.restore_into(m2)
+        after = m2.evaluate(x, y, batch_size=32, verbose=0)
+        assert before == after
+        for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                        jax.tree_util.tree_leaves(m2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_training_resumes_bit_identically(self, devices, tmp_path):
+        """fit -> save -> more fit must equal fit -> save -> restore ->
+        more fit (same batches via the step cursor)."""
+        x, y = _data(128)
+        m = _fsdp_model()
+        m.fit(x, y, batch_size=32, epochs=1, verbose=0)
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m)
+        m.fit(x, y, batch_size=32, epochs=2, initial_epoch=1, verbose=0)
+
+        m2 = _fsdp_model()
+        m2.build((28, 28, 1))
+        ck.restore_into(m2)
+        m2.fit(x, y, batch_size=32, epochs=2, initial_epoch=1, verbose=0)
+        for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                        jax.tree_util.tree_leaves(m2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestShardedLifecycle:
+    def test_manifest_is_commit_marker(self, devices, tmp_path):
+        m = _fsdp_model()
+        m.build((28, 28, 1))
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m, step=5)
+        assert ck.all_steps() == [5]
+        # A dir without manifest.json (aborted save) is invisible.
+        (tmp_path / "ckpt-9").mkdir()
+        assert ck.all_steps() == [5]
+        # Corrupt: manifest promises shards that are missing.
+        mandir = tmp_path / "ckpt-5"
+        manifest = json.loads((mandir / "manifest.json").read_text())
+        manifest["nprocs"] = 2
+        (mandir / "manifest.json").write_text(json.dumps(manifest))
+        m2 = _fsdp_model()
+        m2.build((28, 28, 1))
+        with pytest.raises(FileNotFoundError, match="proc-1"):
+            ck.restore_into(m2, step=5)
+
+    def test_gc_keeps_latest(self, devices, tmp_path):
+        m = _fsdp_model()
+        m.build((28, 28, 1))
+        ck = dtpu.ShardedCheckpointer(tmp_path, keep=2)
+        for s in (1, 2, 3, 4):
+            ck.save(m, step=s)
+        assert ck.all_steps() == [3, 4]
+
+    def test_restore_empty_raises(self, devices, tmp_path):
+        m = _fsdp_model()
+        m.build((28, 28, 1))
+        with pytest.raises(FileNotFoundError):
+            dtpu.ShardedCheckpointer(tmp_path / "nope").restore_into(m)
+
+    def test_wrong_model_raises(self, devices, tmp_path):
+        m = _fsdp_model()
+        m.build((28, 28, 1))
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m, step=1)
+        with dtpu.FullyShardedDataParallel().scope():
+            other = dtpu.Model(
+                dtpu.nn.Sequential([dtpu.nn.Flatten(), dtpu.nn.Dense(10)])
+            )
+            other.compile(optimizer="sgd",
+                          loss="sparse_categorical_crossentropy")
+        other.build((28, 28, 1))
+        with pytest.raises((KeyError, ValueError)):
+            ck.restore_into(other, step=1)
+
+
+def test_model_checkpoint_callback_sharded(devices, tmp_path):
+    """ModelCheckpoint(sharded=True) saves per-process files and a crash
+    relaunch resumes from them."""
+    x, y = _data(128)
+    m = _fsdp_model()
+    m.fit(x, y, batch_size=32, epochs=2, verbose=0,
+          callbacks=[dtpu.callbacks.ModelCheckpoint(tmp_path, sharded=True)])
+    assert (tmp_path / f"ckpt-{m.step}" / "proc-0.npz").exists()
+    assert (tmp_path / f"ckpt-{m.step}" / "manifest.json").exists()
+
+    m2 = _fsdp_model()
+    m2.fit(x, y, batch_size=32, epochs=2, verbose=0,
+           callbacks=[dtpu.callbacks.ModelCheckpoint(tmp_path, sharded=True,
+                                                     restore=True)])
+    # All epochs already done: restore fast-forwards, params identical.
+    for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                    jax.tree_util.tree_leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
