@@ -31,6 +31,7 @@ from .training import callbacks
 from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
+    CompositeParallel,
     DataParallel,
     DataPipelineParallel,
     DataSeqParallel,
@@ -51,6 +52,7 @@ __all__ = [
     "History",
     "Strategy",
     "SingleDevice",
+    "CompositeParallel",
     "DataParallel",
     "DataPipelineParallel",
     "DataSeqParallel",

@@ -48,19 +48,24 @@ def _to_host(val) -> np.ndarray:
     return np.asarray(jax.device_get(val))
 
 
-def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
-    flat = {}
+def iter_leaf_paths(tree, prefix=""):
+    """(path, leaf) pairs: sorted dict keys, '#i' for tuple/list entries,
+    SEP-joined. The single source of truth for checkpoint path naming
+    (flatten_tree and the sharded format both build on it)."""
     if isinstance(tree, dict):
         for k in sorted(tree):
-            flat.update(flatten_tree(tree[k], f"{prefix}{k}{SEP}"))
+            yield from iter_leaf_paths(tree[k], f"{prefix}{k}{SEP}")
     elif isinstance(tree, (list, tuple)):
         for i, v in enumerate(tree):
-            flat.update(flatten_tree(v, f"{prefix}#{i}{SEP}"))
+            yield from iter_leaf_paths(v, f"{prefix}#{i}{SEP}")
     elif tree is None:
-        pass
+        return
     else:
-        flat[prefix.rstrip(SEP)] = _to_host(tree)
-    return flat
+        yield prefix.rstrip(SEP), tree
+
+
+def flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
+    return {p: _to_host(v) for p, v in iter_leaf_paths(tree, prefix)}
 
 
 def unflatten_tree(flat: Dict[str, np.ndarray]):

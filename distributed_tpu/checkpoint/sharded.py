@@ -38,28 +38,14 @@ from __future__ import annotations
 import json
 import re
 from pathlib import Path
-from typing import Any, Dict, Iterator, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
-from .core import SEP, _atomic_write, _is_chief
+from .core import _atomic_write, _is_chief, iter_leaf_paths as _iter_leaf_paths
 
 __all__ = ["ShardedCheckpointer"]
-
-
-def _iter_leaf_paths(tree, prefix="") -> Iterator[Tuple[str, Any]]:
-    """(path, leaf) pairs in the same order/naming as core.flatten_tree."""
-    if isinstance(tree, dict):
-        for k in sorted(tree):
-            yield from _iter_leaf_paths(tree[k], f"{prefix}{k}{SEP}")
-    elif isinstance(tree, (list, tuple)):
-        for i, v in enumerate(tree):
-            yield from _iter_leaf_paths(v, f"{prefix}#{i}{SEP}")
-    elif tree is None:
-        return
-    else:
-        yield prefix.rstrip(SEP), tree
 
 
 def _starts_of(index, shape) -> Tuple[int, ...]:
@@ -253,7 +239,21 @@ class ShardedCheckpointer:
         *replicated* target necessarily assembles full leaves per host,
         exactly matching what that target keeps in device memory anyway.
         """
-        step = self.latest_step() if step is None else step
+        if step is None:
+            step = self.latest_step()
+            if jax.process_count() > 1:
+                # Cross-process agreement: the chief's view of the directory
+                # decides (filesystem visibility can lag on some hosts; a
+                # per-process latest_step() could silently desynchronize
+                # the gang onto different steps).
+                from jax.experimental import multihost_utils
+
+                chosen = np.array(
+                    [-1 if step is None else int(step)], np.int64
+                )
+                step = int(multihost_utils.broadcast_one_to_all(chosen)[0])
+                if step < 0:
+                    step = None
         if step is None:
             raise FileNotFoundError(f"No sharded checkpoints in {self.directory}")
         step_dir = self._step_dir(int(step))
@@ -273,10 +273,15 @@ class ShardedCheckpointer:
                 "params": model.params,
                 "state": model.state if model.state else {},
             }
-            if model.compiled:
+            has_opt = any(
+                p.startswith("opt_state") for p in leaves_meta
+            )
+            if model.compiled and has_opt:
                 templates["opt_state"] = model.strategy.init_opt_state(
                     model.tx, model.params
                 )
+            # Saved-before-compile checkpoints have no opt leaves: keep the
+            # model's fresh optimizer init (same contract as Checkpointer).
 
             def rebuild(path, template_leaf):
                 meta = leaves_meta.get(path)

@@ -1,5 +1,6 @@
 from .mesh import AXES, batch_sharding, make_mesh, replicated
 from .strategy import (
+    CompositeParallel,
     DataParallel,
     DataSeqParallel,
     DataExpertParallel,
@@ -18,6 +19,7 @@ __all__ = [
     "batch_sharding",
     "Strategy",
     "SingleDevice",
+    "CompositeParallel",
     "DataParallel",
     "DataSeqParallel",
     "DataExpertParallel",

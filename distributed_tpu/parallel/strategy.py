@@ -190,12 +190,41 @@ class DataParallel(Strategy):
         return global_batch // n
 
 
+def _check_pipe_divisible(params, hints, n: int, axis_name: str):
+    """Fail with a framework-level message before device_put trips over an
+    indivisible pipelined stage stack."""
+
+    def check(p, h):
+        if isinstance(p, dict):
+            for k, v in p.items():
+                check(v, h.get(k, {}) if isinstance(h, dict) else h)
+        elif h == "pipe" and p.shape[0] % n:
+            raise ValueError(
+                f"{p.shape[0]} pipelined blocks not divisible by "
+                f"{axis_name}={n} stages"
+            )
+
+    check(params, hints or {})
+
+
+def _axis_spans_processes(mesh: Mesh, axis: str) -> bool:
+    """True when devices along `axis` belong to more than one process (so a
+    per-host row-shard can't carry full rows along that axis)."""
+    devs = mesh.devices
+    dim = mesh.axis_names.index(axis)
+    moved = np.moveaxis(devs, dim, -1).reshape(-1, devs.shape[dim])
+    for line in moved:
+        if len({d.process_index for d in line}) > 1:
+            return True
+    return False
+
+
 class _HintedParallel(DataParallel):
     """Shared machinery for strategies that translate layer sharding hints
     (nn.Layer.sharding_hints role strings) into NamedShardings. Subclasses
-    define ``_role_spec(role, ndim)``."""
+    define ``_role_spec(role, shape)``."""
 
-    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+    def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:
         raise NotImplementedError
 
     def params_sharding(self, params, hints=None):
@@ -209,7 +238,7 @@ class _HintedParallel(DataParallel):
                     for k, v in p.items()
                 }
             role = h if isinstance(h, str) else None
-            return NamedSharding(self.mesh, self._role_spec(role, p.ndim))
+            return NamedSharding(self.mesh, self._role_spec(role, p.shape))
 
         return walk(params, hints or {})
 
@@ -275,8 +304,9 @@ class DataTensorParallel(_HintedParallel):
             )
         self.model_axis = model_axis
 
-    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+    def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:
         m = self.model_axis
+        ndim = len(shape)
         if role == "col":  # shard output/features dim (last)
             return PartitionSpec(*([None] * (ndim - 1) + [m]))
         if role == "row":  # shard input dim (first)
@@ -319,10 +349,10 @@ class DataExpertParallel(_HintedParallel):
             )
         self.expert_axis = expert_axis
 
-    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+    def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:
         if role == "expert":  # shard the expert stack (dim 0)
             return PartitionSpec(
-                *([self.expert_axis] + [None] * (ndim - 1))
+                *([self.expert_axis] + [None] * (len(shape) - 1))
             )
         return PartitionSpec()
 
@@ -424,30 +454,17 @@ class DataPipelineParallel(_HintedParallel):
             )
         self.num_microbatches = int(num_microbatches)
 
-    def _role_spec(self, role: Optional[str], ndim: int) -> PartitionSpec:
+    def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:
         if role == "pipe":  # shard the stacked stage dim (dim 0)
             return PartitionSpec(
-                *([self.pipe_axis] + [None] * (ndim - 1))
+                *([self.pipe_axis] + [None] * (len(shape) - 1))
             )
         return PartitionSpec()
 
     def put_params(self, params, hints=None):
-        # Fail with a framework-level message before device_put trips over
-        # an indivisible stage stack.
-        n = int(self.mesh.shape[self.pipe_axis])
-
-        def check(p, h):
-            if isinstance(p, dict):
-                for k, v in p.items():
-                    check(v, h.get(k, {}) if isinstance(h, dict) else h)
-            elif h == "pipe" and p.shape[0] % n:
-                raise ValueError(
-                    f"{p.shape[0]} pipelined blocks not divisible by "
-                    f"{self.pipe_axis}={n} stages"
-                )
-
-        if hints:
-            check(params, hints)
+        _check_pipe_divisible(
+            params, hints, int(self.mesh.shape[self.pipe_axis]), self.pipe_axis
+        )
         return super().put_params(params, hints)
 
 
@@ -541,13 +558,179 @@ class DataSeqParallel(DataParallel):
     def _seq_spans_processes(self) -> bool:
         """True when devices along the seq mesh axis belong to more than
         one process (so a per-host row-shard can't carry full seq rows)."""
-        devs = self.mesh.devices
-        seq_dim = self.mesh.axis_names.index(self.seq_axis)
-        moved = np.moveaxis(devs, seq_dim, -1).reshape(-1, devs.shape[seq_dim])
-        for line in moved:
-            if len({d.process_index for d in line}) > 1:
-                return True
-        return False
+        return _axis_spans_processes(self.mesh, self.seq_axis)
+
+
+class CompositeParallel(_HintedParallel):
+    """General multi-axis parallelism: any subset of the mesh's canonical
+    axes (data, fsdp, pipe, seq, expert, model) applied simultaneously.
+
+    The pairwise strategies above each own 'data' plus one other axis; real
+    large-model configs compose three or more (data x model x pipe,
+    fsdp + model, ...). This strategy is the general form — SURVEY.md §2c's
+    "a NamedSharding mesh makes DP one axis of a general design" carried to
+    its conclusion. All hint roles resolve at once:
+
+    - 'col'/'row'  -> Megatron TP over 'model' (last/first dim)
+    - 'expert'     -> expert stack dim 0 over 'expert'
+    - 'pipe'       -> stage stack dim 0 over 'pipe' (GPipe schedule in
+                      nn.PipelinedBlocks; TP hints *inside* a pipelined
+                      stack are subsumed by the stage sharding — put
+                      TP-hinted layers outside the stack)
+    - unhinted params additionally ZeRO-3-shard their largest divisible
+      dim over 'fsdp' when that axis is present (role-assigned dims are
+      never double-sharded).
+
+    Batch rows shard over every batch-like axis present (('data','fsdp') —
+    the standard hybrid recipe); the sequence dim shards over 'seq' with
+    ring/Ulysses attention exactly as DataSeqParallel.
+    """
+
+    #: axes that shard batch rows (in canonical mesh order)
+    BATCH_AXES = ("data", "fsdp")
+
+    def __init__(
+        self,
+        axes: Optional[dict] = None,
+        devices=None,
+        *,
+        mesh: Optional[Mesh] = None,
+        num_microbatches: Optional[int] = None,
+        seq_attention: str = "ring",
+    ):
+        from .mesh import AXES
+
+        if mesh is None:
+            if not axes:
+                raise ValueError(
+                    "CompositeParallel needs axis sizes, e.g. "
+                    "CompositeParallel({'data': 2, 'model': 2, 'pipe': 2})"
+                )
+            mesh = make_mesh(dict(axes), devices=devices)
+        unknown = set(mesh.axis_names) - set(AXES)
+        if unknown:
+            raise ValueError(
+                f"Mesh axes {sorted(unknown)} are not canonical {AXES}"
+            )
+        row_axes = [a for a in self.BATCH_AXES if a in mesh.axis_names]
+        if not row_axes:
+            raise ValueError(
+                "CompositeParallel needs at least one batch axis "
+                f"({self.BATCH_AXES}) in the mesh; got {mesh.axis_names}"
+            )
+        # `axis` = the primary batch axis (what layers read for activation
+        # sharding constraints); rows shard over ALL of row_axes.
+        super().__init__(mesh=mesh, axis=row_axes[0])
+        self._row_axes = tuple(row_axes)
+
+        def present(name):
+            return name if (
+                name in mesh.axis_names and int(mesh.shape[name]) > 1
+            ) else None
+
+        self.model_axis = present("model")
+        self.pipe_axis = present("pipe")
+        self.seq_axis = present("seq")
+        self.expert_axis = present("expert")
+        self.fsdp_axis = present("fsdp")
+        if seq_attention not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention must be 'ring' or 'ulysses', got {seq_attention!r}"
+            )
+        self.seq_attention = seq_attention
+        if num_microbatches is None:
+            num_microbatches = (
+                int(mesh.shape[self.pipe_axis]) if self.pipe_axis else 1
+            )
+        if num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be >= 1, got {num_microbatches}"
+            )
+        self.num_microbatches = int(num_microbatches)
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        n = 1
+        for a in self._row_axes:
+            n *= int(self.mesh.shape[a])
+        return n
+
+    # -- parameter placement -------------------------------------------------
+    def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:
+        spec = [None] * len(shape)
+        if role in ("col", "row") and self.model_axis:
+            spec[-1 if role == "col" else 0] = self.model_axis
+        elif role == "expert" and self.expert_axis:
+            spec[0] = self.expert_axis
+        elif role == "pipe" and self.pipe_axis:
+            spec[0] = self.pipe_axis
+        if self.fsdp_axis and role != "pipe":
+            # ZeRO-3 overlay on the largest free divisible dim. Pipelined
+            # stacks are excluded: their shard_map in_specs mention only
+            # 'pipe', so an fsdp overlay would just be re-gathered at the
+            # shard_map boundary every step.
+            n = int(self.mesh.shape[self.fsdp_axis])
+            best, best_size = None, 0
+            for d, size in enumerate(shape):
+                if spec[d] is None and size % n == 0 and size > best_size:
+                    best, best_size = d, size
+            if best is not None:
+                spec[best] = self.fsdp_axis
+        return PartitionSpec(*spec)
+
+    def put_params(self, params, hints=None):
+        if self.pipe_axis:
+            _check_pipe_divisible(
+                params, hints, int(self.mesh.shape[self.pipe_axis]),
+                self.pipe_axis,
+            )
+        # Unlike _HintedParallel, hints=None still shards (the fsdp
+        # overlay applies to unhinted params too).
+        return jax.device_put(params, self.params_sharding(params, hints))
+
+    # -- batch placement -----------------------------------------------------
+    def batch_sharding(self):
+        return NamedSharding(self.mesh, PartitionSpec(self._row_axes))
+
+    def put_batch(self, batch, per_host: bool = False):
+        rows = self._row_axes if len(self._row_axes) > 1 else self._row_axes[0]
+
+        def _put(x):
+            x = np.asarray(x)
+            if self.seq_axis and x.ndim >= 2:
+                seq_len = x.shape[1]
+                n_seq = int(self.mesh.shape[self.seq_axis])
+                if seq_len % n_seq:
+                    raise ValueError(
+                        f"sequence length {seq_len} not divisible by "
+                        f"{self.seq_axis}={n_seq} shards"
+                    )
+                spec = PartitionSpec(
+                    rows, self.seq_axis, *([None] * (x.ndim - 2))
+                )
+            else:
+                spec = PartitionSpec(rows)
+            sh = NamedSharding(self.mesh, spec)
+            if per_host:
+                # Same constraint as DataSeqParallel: a per-host row shard
+                # carries the FULL sequence, which only maps onto this
+                # process's addressable shards when no seq split crosses a
+                # process boundary.
+                if (
+                    self.seq_axis
+                    and x.ndim >= 2
+                    and _axis_spans_processes(self.mesh, self.seq_axis)
+                ):
+                    raise ValueError(
+                        "per-host sharded input is unsupported when the "
+                        f"'{self.seq_axis}' axis spans processes: each "
+                        "process would also need to pre-slice its sequence "
+                        "shard. Feed host-global batches instead"
+                    )
+                return jax.make_array_from_process_local_data(sh, x)
+            return _put_global(x, sh)
+
+        return jax.tree_util.tree_map(_put, batch)
 
 
 # Alias keeping the reference's class name greppable for migrating users.

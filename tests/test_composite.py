@@ -1,0 +1,197 @@
+"""CompositeParallel: multi-axis strategy composition on the 8-device sim
+(VERDICT round 2, weak #5: pairwise-only strategies; this is the general
+data/fsdp/pipe/seq/expert/model form — at minimum data x model x pipe and
+fsdp + model must train)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+def _pipe_tp_lm(vocab=64, d=32, heads=4, blocks=2, max_len=16):
+    """LM with a pipelined block stack AND a TP-hinted head outside it:
+    exercises 'pipe' and 'model' roles in one model. (TP hints inside a
+    pipelined stack are subsumed by the stage sharding by design.)"""
+    from distributed_tpu.models.transformer import transformer_block
+
+    def make_block():
+        return nn.Sequential(transformer_block(d, heads, 4 * d))
+
+    return nn.Sequential(
+        [
+            nn.Embedding(vocab, d),
+            nn.PositionalEmbedding(max_len),
+            nn.PipelinedBlocks(make_block, blocks),
+            nn.LayerNorm(),
+            nn.Dense(vocab, shard="col"),
+        ],
+        name="pipe_tp_lm",
+    )
+
+
+def _tokens(b, t=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (b, t + 1), dtype=np.int64)
+    return tok[:, :-1].astype(np.int32), tok[:, 1:].astype(np.int32)
+
+
+class TestConstruction:
+    def test_requires_axes(self, devices):
+        with pytest.raises(ValueError, match="axis sizes"):
+            dtpu.CompositeParallel()
+
+    def test_unknown_axis_rejected(self, devices):
+        with pytest.raises(ValueError, match="Unknown mesh axes"):
+            dtpu.CompositeParallel({"data": 4, "banana": 2})
+
+    def test_needs_batch_axis(self, devices):
+        with pytest.raises(ValueError, match="batch axis"):
+            dtpu.CompositeParallel({"model": 4, "pipe": 2})
+
+    def test_bad_attention_mode(self, devices):
+        with pytest.raises(ValueError, match="ring"):
+            dtpu.CompositeParallel({"data": 4, "seq": 2}, seq_attention="nope")
+
+    def test_replica_count_spans_data_and_fsdp(self, devices):
+        s = dtpu.CompositeParallel({"data": 2, "fsdp": 2, "model": 2})
+        assert s.num_replicas_in_sync == 4
+        assert s.model_axis == "model" and s.fsdp_axis == "fsdp"
+        s2 = dtpu.CompositeParallel({"data": 4, "model": 2})
+        assert s2.num_replicas_in_sync == 4 and s2.fsdp_axis is None
+
+
+class TestDataModelPipe:
+    def test_trains_with_tp_and_pipe_shardings(self, devices):
+        strategy = dtpu.CompositeParallel({"data": 2, "model": 2, "pipe": 2})
+        with strategy.scope():
+            m = dtpu.Model(_pipe_tp_lm())
+            m.compile(optimizer=dtpu.optim.Adam(1e-2),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        x, y = _tokens(8)
+        hist = m.fit(x, y, batch_size=8, epochs=3, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+        # TP head sharded over 'model' on its output dim:
+        head = m.params["dense"]["kernel"]
+        assert head.sharding.spec == P(None, "model"), head.sharding
+        # Pipe stack sharded over 'pipe' on the stage dim:
+        for leaf in jax.tree_util.tree_leaves(
+            m.params["pipelined_blocks"]["blocks"]
+        ):
+            assert leaf.sharding.spec[0] == "pipe", leaf.sharding
+
+    def test_matches_single_device_numerics(self, devices):
+        """One train step under data x model x pipe equals the same step on
+        one device (the invariant every strategy in the framework holds)."""
+        x, y = _tokens(8)
+
+        def run(strategy):
+            ctx = strategy.scope() if strategy else _null()
+            with ctx:
+                m = dtpu.Model(_pipe_tp_lm())
+                m.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+            m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1,
+                  verbose=0, shuffle=False)
+            return jax.tree_util.tree_map(np.asarray, m.params)
+
+        import contextlib
+
+        def _null():
+            return contextlib.nullcontext()
+
+        single = run(None)
+        comp = run(dtpu.CompositeParallel({"data": 2, "model": 2, "pipe": 2}))
+        for a, b in zip(jax.tree_util.tree_leaves(single),
+                        jax.tree_util.tree_leaves(comp)):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+class TestFsdpModel:
+    def test_trains_with_both_shardings(self, devices):
+        strategy = dtpu.CompositeParallel({"fsdp": 4, "model": 2})
+        with strategy.scope():
+            m = dtpu.Model(dtpu.models.transformer_lm(
+                64, num_layers=2, d_model=32, num_heads=4, max_len=16))
+            m.compile(optimizer=dtpu.optim.Adam(1e-2),
+                      loss="sparse_categorical_crossentropy")
+        x, y = _tokens(8)
+        hist = m.fit(x, y, batch_size=8, epochs=2, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+        leaves = jax.tree_util.tree_leaves(m.params)
+        model_sharded = [
+            l for l in leaves if "model" in jax.tree_util.tree_leaves(
+                [ax for ax in l.sharding.spec if ax is not None])
+        ]
+        fsdp_sharded = [
+            l for l in leaves
+            if any(ax == "fsdp" for ax in l.sharding.spec)
+        ]
+        assert model_sharded, "no Megatron-sharded params"
+        assert fsdp_sharded, "no ZeRO-sharded params"
+        # A TP kernel gets BOTH: 'model' on its role dim, 'fsdp' overlaid
+        # on the other (wq is (d, d), both dims divisible).
+        wq = m.params["residual"]["main"]["multi_head_attention"]["wq"]
+        assert set(ax for ax in wq.sharding.spec if ax) == {"model", "fsdp"}
+        # Optimizer state inherits the composed shardings.
+        mu_wq = m.opt_state[0].mu["residual"]["main"]["multi_head_attention"]["wq"]
+        assert mu_wq.sharding.spec == wq.sharding.spec
+
+    def test_matches_dp_numerics(self, devices):
+        x, y = _tokens(8)
+
+        def run(strategy):
+            with strategy.scope():
+                m = dtpu.Model(dtpu.models.transformer_lm(
+                    64, num_layers=1, d_model=32, num_heads=4, max_len=16))
+                m.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+            m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1,
+                  verbose=0, shuffle=False)
+            return jax.tree_util.tree_map(np.asarray, m.params)
+
+        dp = run(dtpu.DataParallel())
+        comp = run(dtpu.CompositeParallel({"fsdp": 4, "model": 2}))
+        for a, b in zip(jax.tree_util.tree_leaves(dp),
+                        jax.tree_util.tree_leaves(comp)):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+class TestDataSeq:
+    def test_equals_dataseqparallel(self, devices):
+        """CompositeParallel({'data','seq'}) must reproduce DataSeqParallel
+        (ring attention over the seq axis) exactly."""
+        x, y = _tokens(8, t=16)
+
+        def run(strategy):
+            with strategy.scope():
+                m = dtpu.Model(dtpu.models.transformer_lm(
+                    64, num_layers=1, d_model=32, num_heads=4, max_len=16))
+                m.compile(optimizer=dtpu.optim.SGD(0.1),
+                          loss="sparse_categorical_crossentropy")
+            m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1,
+                  verbose=0, shuffle=False)
+            return jax.tree_util.tree_map(np.asarray, m.params)
+
+        ref = run(dtpu.DataSeqParallel(seq_parallel=2))
+        comp = run(dtpu.CompositeParallel({"data": 4, "seq": 2}))
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(comp)):
+            np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+    def test_seq_divisibility_checked(self, devices):
+        s = dtpu.CompositeParallel({"data": 4, "seq": 2})
+        with pytest.raises(ValueError, match="not divisible"):
+            s.put_batch({"x": np.zeros((8, 15), np.int32)})
+
+
+def test_batch_rows_shard_over_data_and_fsdp(devices):
+    s = dtpu.CompositeParallel({"data": 2, "fsdp": 2, "model": 2})
+    b = s.put_batch({"x": np.zeros((8, 4), np.float32)})["x"]
+    # 4-way row sharding: each device holds 2 rows.
+    row_counts = {sh.data.shape[0] for sh in b.addressable_shards}
+    assert row_counts == {2}, row_counts

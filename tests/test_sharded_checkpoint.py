@@ -179,3 +179,25 @@ def test_model_checkpoint_callback_sharded(devices, tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(m.params),
                     jax.tree_util.tree_leaves(m2.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_uncompiled_save_keeps_fresh_opt(devices, tmp_path):
+    """A checkpoint saved before compile() has no optimizer leaves; restoring
+    it into a compiled model must keep the fresh optimizer init (same
+    contract as Checkpointer), not raise."""
+    with dtpu.FullyShardedDataParallel().scope():
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+    m.build((28, 28, 1))
+    ck = dtpu.ShardedCheckpointer(tmp_path)
+    ck.save(m, step=0)
+
+    m2 = _fsdp_model()
+    m2.build((28, 28, 1))
+    fresh = jax.tree_util.tree_map(np.asarray, m2.opt_state)
+    ck.restore_into(m2, step=0)
+    for a, b in zip(jax.tree_util.tree_leaves(fresh),
+                    jax.tree_util.tree_leaves(m2.opt_state)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                    jax.tree_util.tree_leaves(m2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
