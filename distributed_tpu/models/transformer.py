@@ -24,17 +24,20 @@ def transformer_block(
     *,
     causal: bool = True,
     moe_experts: int = 0,
+    flash="auto",
     dtype=None,
 ) -> list:
     """Pre-LN block as two Residuals: [LN -> MHA] + [LN -> MLP-or-MoE].
 
     ``moe_experts > 0`` swaps the dense MLP for an nn.MoE with that many
-    experts (expert-parallel under DataExpertParallel)."""
+    experts (expert-parallel under DataExpertParallel). ``flash`` passes
+    through to MultiHeadAttention (True/False/'auto')."""
     attn = nn.Residual(
         nn.Sequential(
             [
                 nn.LayerNorm(),
-                nn.MultiHeadAttention(num_heads, causal=causal, dtype=dtype),
+                nn.MultiHeadAttention(num_heads, causal=causal, flash=flash,
+                                      dtype=dtype),
             ],
             name="main",
         )
@@ -66,6 +69,7 @@ def transformer_lm(
     moe_every: int = 2,
     pipeline: bool = False,
     remat: bool = False,
+    flash="auto",
     dtype=None,
 ) -> nn.Sequential:
     """Token-in, logits-out LM: (B, T) int32 -> (B, T, vocab).
@@ -94,7 +98,8 @@ def transformer_lm(
         def make_block():
             block = nn.Sequential(
                 transformer_block(
-                    d_model, num_heads, d_ff, causal=causal, dtype=dtype
+                    d_model, num_heads, d_ff, causal=causal, flash=flash,
+                    dtype=dtype,
                 )
             )
             return nn.Remat(block) if remat else block
@@ -105,7 +110,7 @@ def transformer_lm(
             moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0
             block = transformer_block(
                 d_model, num_heads, d_ff, causal=causal, moe_experts=moe,
-                dtype=dtype,
+                flash=flash, dtype=dtype,
             )
             if remat:
                 block = [nn.Remat(residual) for residual in block]

@@ -121,8 +121,19 @@ class MultiHeadAttention(Layer):
         back — GSPMD lowers each to one all-to-all over the seq axis — so
         every device runs full-sequence attention for its head slice. One
         collective pair per layer vs ring's n-1 ppermutes; requires
-        num_heads divisible by the seq-axis size."""
+        num_heads divisible by the seq-axis size.
+
+        Per head shard the attention runs the flash (blockwise) kernel via
+        shard_map, so device memory is O(T*d) — at the long contexts
+        Ulysses exists for, a dense per-shard (T, T) score matrix would
+        reintroduce exactly the O(T^2) the seq axis removed. ``flash=False``
+        on the layer keeps the dense path (debug/tiny-T escape hatch)."""
+        import functools
+
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.flash_attention import flash_attention
+        from ..parallel.auto_shard import shard_rows
 
         seq_axis = self.ring_axis
         n_seq = int(mesh.shape[seq_axis])
@@ -136,15 +147,28 @@ class MultiHeadAttention(Layer):
         seq_sh = NamedSharding(mesh, P(batch_axis, seq_axis, None, None))
         wsc = jax.lax.with_sharding_constraint
         q, k, v = (wsc(a, head_sh) for a in (q, k, v))
-        b, t, _, hd = q.shape
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(jnp.float32(hd))
-        if self.causal:
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
-        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        # Same gating as the main path (_use_flash): 'auto' takes the
+        # blockwise kernel only at long T on a TPU backend — on CPU/GPU the
+        # Pallas interpret/fallback path would be far slower than dense.
+        if not self._use_flash(q.shape[1]):
+            b, t, _, hd = q.shape
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(jnp.float32(hd))
+            if self.causal:
+                mask = jnp.tril(jnp.ones((t, t), bool))
+                scores = jnp.where(
+                    mask[None, None], scores, jnp.float32(-1e30)
+                )
+            attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+        else:
+            fn = functools.partial(flash_attention, causal=self.causal)
+            spec = P(batch_axis, None, seq_axis, None)
+            ctx = shard_rows(
+                fn, (q, k, v), (spec, spec, spec), spec,
+                allowed_axes={batch_axis, seq_axis},
+            )
         return wsc(ctx, seq_sh)
 
     def _use_flash(self, t: int) -> bool:

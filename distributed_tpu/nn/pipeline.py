@@ -179,8 +179,17 @@ class PipelinedBlocks(Layer):
                 f"{self.num_blocks} blocks not divisible by "
                 f"{pipe_axis}={n} stages"
             )
-        data_axis = getattr(strategy, "axis", "data")
-        n_data = int(mesh.shape.get(data_axis, 1))
+        # Batch rows may shard over several axes (CompositeParallel rows
+        # over ('data','fsdp')); honor them all so the schedule's shard_map
+        # doesn't silently all-gather the extra folds and recompute the
+        # pipeline per-slice.
+        row_axes = tuple(
+            a for a in getattr(strategy, "_row_axes", ())
+            if a in mesh.axis_names
+        ) or (getattr(strategy, "axis", "data"),)
+        n_data = 1
+        for a in row_axes:
+            n_data *= int(mesh.shape.get(a, 1))
         m = int(getattr(strategy, "num_microbatches", n))
         b_global = x.shape[0]
         if b_global % (n_data * m):
@@ -191,7 +200,8 @@ class PipelinedBlocks(Layer):
         b_local = b_global // n_data
         mb = b_local // m
         feat_none = (None,) * (x.ndim - 1)
-        x_spec = PartitionSpec(data_axis, *feat_none)
+        rows = row_axes if len(row_axes) > 1 else row_axes[0]
+        x_spec = PartitionSpec(rows, *feat_none)
         p_specs = jax.tree_util.tree_map(
             lambda a: PartitionSpec(pipe_axis, *((None,) * (a.ndim - 1))),
             stacked,

@@ -207,6 +207,48 @@ def _check_pipe_divisible(params, hints, n: int, axis_name: str):
     check(params, hints or {})
 
 
+def _put_batch_rows_seq(mesh: Mesh, rows, seq_axis: Optional[str], batch,
+                        per_host: bool):
+    """Shared batch placement for strategies with row sharding and an
+    optional sequence axis (DataSeqParallel, CompositeParallel): rows shard
+    over ``rows`` (one axis name or a tuple), dim 1 over ``seq_axis`` when
+    present and the leaf has one."""
+
+    def _put(x):
+        x = np.asarray(x)
+        if seq_axis and x.ndim >= 2:
+            seq_len = x.shape[1]
+            n_seq = int(mesh.shape[seq_axis])
+            if seq_len % n_seq:
+                raise ValueError(
+                    f"sequence length {seq_len} not divisible by "
+                    f"{seq_axis}={n_seq} shards"
+                )
+            spec = PartitionSpec(rows, seq_axis, *([None] * (x.ndim - 2)))
+        else:
+            spec = PartitionSpec(rows)
+        sh = NamedSharding(mesh, spec)
+        if per_host:
+            # A per-host row shard carries the FULL sequence, which only
+            # maps onto this process's addressable shards when no seq
+            # split crosses a process boundary.
+            if (
+                seq_axis
+                and x.ndim >= 2
+                and _axis_spans_processes(mesh, seq_axis)
+            ):
+                raise ValueError(
+                    "per-host sharded input is unsupported when the "
+                    f"'{seq_axis}' axis spans processes: each process "
+                    "would also need to pre-slice its sequence shard. "
+                    "Feed host-global batches instead"
+                )
+            return jax.make_array_from_process_local_data(sh, x)
+        return _put_global(x, sh)
+
+    return jax.tree_util.tree_map(_put, batch)
+
+
 def _axis_spans_processes(mesh: Mesh, axis: str) -> bool:
     """True when devices along `axis` belong to more than one process (so a
     per-host row-shard can't carry full rows along that axis)."""
@@ -523,42 +565,9 @@ class DataSeqParallel(DataParallel):
         return NamedSharding(self.mesh, PartitionSpec(self.axis, self.seq_axis))
 
     def put_batch(self, batch, per_host: bool = False):
-        def _put(x):
-            x = np.asarray(x)
-            if x.ndim >= 2:
-                seq_len = x.shape[1]
-                n_seq = int(self.mesh.shape[self.seq_axis])
-                if seq_len % n_seq:
-                    raise ValueError(
-                        f"sequence length {seq_len} not divisible by "
-                        f"{self.seq_axis}={n_seq} shards"
-                    )
-                spec = PartitionSpec(
-                    self.axis, self.seq_axis, *([None] * (x.ndim - 2))
-                )
-            else:
-                spec = PartitionSpec(self.axis)
-            sh = NamedSharding(self.mesh, spec)
-            if per_host:
-                # Each process holds its row-shard with the FULL sequence
-                # length. That only maps onto the process's addressable
-                # shards when no seq split crosses a process boundary.
-                if x.ndim >= 2 and self._seq_spans_processes():
-                    raise ValueError(
-                        "per-host sharded input is unsupported when the "
-                        f"'{self.seq_axis}' axis spans processes: each "
-                        "process would also need to pre-slice its sequence "
-                        "shard. Feed host-global batches instead"
-                    )
-                return jax.make_array_from_process_local_data(sh, x)
-            return _put_global(x, sh)
-
-        return jax.tree_util.tree_map(_put, batch)
-
-    def _seq_spans_processes(self) -> bool:
-        """True when devices along the seq mesh axis belong to more than
-        one process (so a per-host row-shard can't carry full seq rows)."""
-        return _axis_spans_processes(self.mesh, self.seq_axis)
+        return _put_batch_rows_seq(
+            self.mesh, self.axis, self.seq_axis, batch, per_host
+        )
 
 
 class CompositeParallel(_HintedParallel):
@@ -694,43 +703,9 @@ class CompositeParallel(_HintedParallel):
 
     def put_batch(self, batch, per_host: bool = False):
         rows = self._row_axes if len(self._row_axes) > 1 else self._row_axes[0]
-
-        def _put(x):
-            x = np.asarray(x)
-            if self.seq_axis and x.ndim >= 2:
-                seq_len = x.shape[1]
-                n_seq = int(self.mesh.shape[self.seq_axis])
-                if seq_len % n_seq:
-                    raise ValueError(
-                        f"sequence length {seq_len} not divisible by "
-                        f"{self.seq_axis}={n_seq} shards"
-                    )
-                spec = PartitionSpec(
-                    rows, self.seq_axis, *([None] * (x.ndim - 2))
-                )
-            else:
-                spec = PartitionSpec(rows)
-            sh = NamedSharding(self.mesh, spec)
-            if per_host:
-                # Same constraint as DataSeqParallel: a per-host row shard
-                # carries the FULL sequence, which only maps onto this
-                # process's addressable shards when no seq split crosses a
-                # process boundary.
-                if (
-                    self.seq_axis
-                    and x.ndim >= 2
-                    and _axis_spans_processes(self.mesh, self.seq_axis)
-                ):
-                    raise ValueError(
-                        "per-host sharded input is unsupported when the "
-                        f"'{self.seq_axis}' axis spans processes: each "
-                        "process would also need to pre-slice its sequence "
-                        "shard. Feed host-global batches instead"
-                    )
-                return jax.make_array_from_process_local_data(sh, x)
-            return _put_global(x, sh)
-
-        return jax.tree_util.tree_map(_put, batch)
+        return _put_batch_rows_seq(
+            self.mesh, rows, self.seq_axis, batch, per_host
+        )
 
 
 # Alias keeping the reference's class name greppable for migrating users.

@@ -195,3 +195,27 @@ def test_batch_rows_shard_over_data_and_fsdp(devices):
     # 4-way row sharding: each device holds 2 rows.
     row_counts = {sh.data.shape[0] for sh in b.addressable_shards}
     assert row_counts == {2}, row_counts
+
+
+def test_data_fsdp_pipe_trains_and_matches_single_device(devices):
+    """Batch rows shard over BOTH data and fsdp while blocks pipeline:
+    PipelinedBlocks must honor the multi-axis row sharding (not all-gather
+    the fsdp fold and recompute the schedule per slice)."""
+    x, y = _tokens(8)
+
+    def run(strategy):
+        import contextlib
+        ctx = strategy.scope() if strategy else contextlib.nullcontext()
+        with ctx:
+            m = dtpu.Model(_pipe_tp_lm())
+            m.compile(optimizer=dtpu.optim.SGD(0.1),
+                      loss="sparse_categorical_crossentropy")
+        m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1,
+              verbose=0, shuffle=False)
+        return jax.tree_util.tree_map(np.asarray, m.params)
+
+    single = run(None)
+    comp = run(dtpu.CompositeParallel({"data": 2, "fsdp": 2, "pipe": 2}))
+    for a, b in zip(jax.tree_util.tree_leaves(single),
+                    jax.tree_util.tree_leaves(comp)):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
