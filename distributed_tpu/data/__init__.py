@@ -6,10 +6,13 @@ from .datasets import (
     load_mnist,
     synthetic_images,
 )
+from .filesource import FileSource, write_shards
 from .pipeline import Pipeline, native_available
 
 __all__ = [
     "Pipeline",
+    "FileSource",
+    "write_shards",
     "native_available",
     "load",
     "load_mnist",

@@ -19,6 +19,7 @@
 // constructed with the same arguments emit identical streams regardless of
 // thread count or timing.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -61,7 +62,13 @@ struct Slot {
 }  // namespace
 
 struct DtpuPipeline {
-  const uint8_t* x;
+  // Row storage as spans: one span for an in-memory array, several for a
+  // file-backed source (each span is one memory-mapped shard file). Rows
+  // are resolved span-first via binary search on cumulative starts, so the
+  // gather path is identical for both; the OS pages mapped shards in and
+  // out on demand, which is what makes larger-than-RAM datasets feedable.
+  std::vector<const uint8_t*> xs;
+  std::vector<int64_t> span_starts;  // size == xs.size() + 1; last == n
   const int32_t* y;
   int64_t n, row, batch, steps_per_pass;
   // Per-host sharding: this producer prepares only rows
@@ -127,7 +134,13 @@ struct DtpuPipeline {
     slot.y.resize((size_t)shard_rows);
     for (int64_t b = 0; b < shard_rows; ++b) {
       const int64_t src = order[start + b];
-      const uint8_t* in = x + src * row;
+      // Span holding row `src`: last start <= src.
+      const size_t span =
+          (size_t)(std::upper_bound(span_starts.begin(), span_starts.end(),
+                                    src) -
+                   span_starts.begin()) -
+          1;
+      const uint8_t* in = xs[span] + (src - span_starts[span]) * row;
       float* out = slot.x.data() + b * row;
       for (int64_t e = 0; e < row; ++e) out[e] = (float)in[e] * scale;
       slot.y[(size_t)b] = y ? y[src] : 0;
@@ -161,19 +174,33 @@ struct DtpuPipeline {
 
 extern "C" {
 
-DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
-                                   int64_t n, int64_t row_elems,
-                                   int64_t batch, int shuffle, uint64_t seed,
-                                   int depth, int threads, float scale,
-                                   int64_t start_step, int64_t shard_index,
-                                   int64_t shard_count) {
+// Spans form: `xs` is `n_spans` base pointers, `span_rows` their row
+// counts (summing to n). The single-array entry point below wraps this
+// with one span; a file-backed source passes one span per mapped shard.
+DtpuPipeline* dtpu_pipeline_create_spans(
+    const uint8_t* const* xs, const int64_t* span_rows, int64_t n_spans,
+    const int32_t* y, int64_t n, int64_t row_elems, int64_t batch,
+    int shuffle, uint64_t seed, int depth, int threads, float scale,
+    int64_t start_step, int64_t shard_index, int64_t shard_count) {
   if (n <= 0 || batch <= 0 || batch > n || row_elems <= 0) return nullptr;
+  if (n_spans < 1) return nullptr;
   if (shard_count < 1 || shard_index < 0 || shard_index >= shard_count ||
       batch % shard_count != 0) {
     return nullptr;
   }
+  int64_t total = 0;
+  for (int64_t i = 0; i < n_spans; ++i) {
+    if (span_rows[i] <= 0 || xs[i] == nullptr) return nullptr;
+    total += span_rows[i];
+  }
+  if (total != n) return nullptr;
   auto* p = new DtpuPipeline();
-  p->x = x;
+  p->xs.assign(xs, xs + n_spans);
+  p->span_starts.resize((size_t)n_spans + 1);
+  p->span_starts[0] = 0;
+  for (int64_t i = 0; i < n_spans; ++i) {
+    p->span_starts[(size_t)i + 1] = p->span_starts[(size_t)i] + span_rows[i];
+  }
   p->y = y;
   p->n = n;
   p->row = row_elems;
@@ -198,6 +225,19 @@ DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
     p->workers.emplace_back([p] { p->worker(); });
   }
   return p;
+}
+
+DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
+                                   int64_t n, int64_t row_elems,
+                                   int64_t batch, int shuffle, uint64_t seed,
+                                   int depth, int threads, float scale,
+                                   int64_t start_step, int64_t shard_index,
+                                   int64_t shard_count) {
+  const uint8_t* xs[1] = {x};
+  const int64_t rows[1] = {n};
+  return dtpu_pipeline_create_spans(xs, rows, 1, y, n, row_elems, batch,
+                                    shuffle, seed, depth, threads, scale,
+                                    start_step, shard_index, shard_count);
 }
 
 // Copies the next batch (in deterministic step order) into caller buffers of

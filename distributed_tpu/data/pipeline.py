@@ -80,9 +80,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
         except (OSError, subprocess.SubprocessError) as e:
             dlog.warning(f"native pipeline unavailable ({e}); using Python")
             return None
-        lib.dtpu_pipeline_create.restype = ctypes.c_void_p
-        lib.dtpu_pipeline_create.argtypes = [
-            ctypes.c_void_p,  # x
+        lib.dtpu_pipeline_create_spans.restype = ctypes.c_void_p
+        lib.dtpu_pipeline_create_spans.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p),  # xs (span base pointers)
+            ctypes.POINTER(ctypes.c_int64),   # span_rows
+            ctypes.c_int64,   # n_spans
             ctypes.c_void_p,  # y
             ctypes.c_int64,   # n
             ctypes.c_int64,   # row_elems
@@ -141,9 +143,9 @@ class Pipeline:
 
     def __init__(
         self,
-        x: np.ndarray,
-        y: Optional[np.ndarray],
-        batch_size: int,
+        x,
+        y: Optional[np.ndarray] = None,
+        batch_size: int = 32,
         *,
         shuffle: bool = True,
         seed: int = 0,
@@ -153,18 +155,38 @@ class Pipeline:
         use_native: Optional[bool] = None,
         shard: Optional[Tuple[int, int]] = None,
     ):
-        x = np.ascontiguousarray(x)
-        if x.dtype != np.uint8:
-            raise TypeError(f"Pipeline feeds raw uint8 data, got {x.dtype}")
-        if batch_size <= 0 or batch_size > x.shape[0]:
+        from .filesource import FileSource
+
+        # x is either an in-memory uint8 array or a file-backed shard set
+        # (FileSource, or a directory path); the file case streams through
+        # memory-mapped spans and never loads the dataset into RAM.
+        self._source: Optional[FileSource] = None
+        if isinstance(x, (str, os.PathLike)):
+            x = FileSource(x)
+        if isinstance(x, FileSource):
+            self._source = x
+            if y is None:
+                y = x.y  # labels from the shard set, if present
+            n_rows = x.n
+            row_shape = x.row_shape
+            self._x = None
+        else:
+            x = np.ascontiguousarray(x)
+            if x.dtype != np.uint8:
+                raise TypeError(
+                    f"Pipeline feeds raw uint8 data, got {x.dtype}"
+                )
+            self._x = x
+            n_rows = x.shape[0]
+            row_shape = x.shape[1:]
+        if batch_size <= 0 or batch_size > n_rows:
             raise ValueError(
-                f"batch_size {batch_size} invalid for {x.shape[0]} rows"
+                f"batch_size {batch_size} invalid for {n_rows} rows"
             )
-        self._x = x
         self._y = (
             None if y is None else np.ascontiguousarray(y, dtype=np.int32)
         )
-        if self._y is not None and len(self._y) != len(x):
+        if self._y is not None and len(self._y) != n_rows:
             raise ValueError("x and y lengths differ")
         self.batch_size = int(batch_size)
         if shard is None:
@@ -184,10 +206,11 @@ class Pipeline:
         self.scale = float(scale)
         self.prefetch = max(1, int(prefetch))
         self.num_threads = max(1, int(num_threads))
-        self.steps_per_pass = x.shape[0] // self.batch_size
+        self._n = int(n_rows)
+        self.steps_per_pass = self._n // self.batch_size
         # Emitted (local) shape; batch_size stays the global batch.
-        self.batch_shape = (self.shard_rows,) + x.shape[1:]
-        self._row = int(np.prod(x.shape[1:], dtype=np.int64))
+        self.batch_shape = (self.shard_rows,) + tuple(row_shape)
+        self._row = int(np.prod(row_shape, dtype=np.int64))
 
         lib = _load_native() if use_native in (None, True) else None
         if use_native is True and lib is None:
@@ -201,11 +224,25 @@ class Pipeline:
             self._handle = self._create_handle(0)
 
     def _create_handle(self, start_step: int):
-        handle = self._lib.dtpu_pipeline_create(
-            self._x.ctypes.data_as(ctypes.c_void_p),
+        # One span for an in-memory array; one per memory-mapped shard for
+        # a FileSource (np.memmap exposes the mapping's base address via
+        # .ctypes like any ndarray — no copy).
+        if self._source is not None:
+            arrays = self._source.x_shards
+        else:
+            arrays = [self._x]
+        n_spans = len(arrays)
+        xs = (ctypes.c_void_p * n_spans)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays]
+        )
+        rows = (ctypes.c_int64 * n_spans)(*[a.shape[0] for a in arrays])
+        handle = self._lib.dtpu_pipeline_create_spans(
+            ctypes.cast(xs, ctypes.POINTER(ctypes.c_void_p)),
+            ctypes.cast(rows, ctypes.POINTER(ctypes.c_int64)),
+            n_spans,
             None if self._y is None
             else self._y.ctypes.data_as(ctypes.c_void_p),
-            self._x.shape[0],
+            self._n,
             self._row,
             self.batch_size,
             1 if self.shuffle else 0,
@@ -269,16 +306,20 @@ class Pipeline:
         else:
             rng = np.random.default_rng((self.seed, pass_idx))
             order = (
-                rng.permutation(self._x.shape[0])
+                rng.permutation(self._n)
                 if self.shuffle
-                else np.arange(self._x.shape[0])
+                else np.arange(self._n)
             )
             self._perm_cache = (pass_idx, order)
         start = within * self.batch_size
         if self.shard is not None:
             start += self.shard[0] * self.shard_rows
         idx = order[start : start + self.shard_rows]
-        xb[:] = self._x[idx].astype(np.float32) * self.scale
+        rows = (
+            self._source.gather(idx) if self._source is not None
+            else self._x[idx]
+        )
+        xb[:] = rows.astype(np.float32) * self.scale
         if self._y is not None:
             yb[:] = self._y[idx]
         else:

@@ -151,17 +151,9 @@ class MultiHeadAttention(Layer):
         # blockwise kernel only at long T on a TPU backend — on CPU/GPU the
         # Pallas interpret/fallback path would be far slower than dense.
         if not self._use_flash(q.shape[1]):
-            b, t, _, hd = q.shape
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-            ) / jnp.sqrt(jnp.float32(hd))
-            if self.causal:
-                mask = jnp.tril(jnp.ones((t, t), bool))
-                scores = jnp.where(
-                    mask[None, None], scores, jnp.float32(-1e30)
-                )
-            attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+            from ..ops.flash_attention import dense_attention
+
+            ctx = dense_attention(q, k, v, self.causal)
         else:
             fn = functools.partial(flash_attention, causal=self.causal)
             spec = P(batch_axis, None, seq_axis, None)
@@ -285,16 +277,9 @@ class MultiHeadAttention(Layer):
         elif self._use_flash(t):
             ctx = self._flash_call(q, k, v)
         else:
-            scores = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-            ) / jnp.sqrt(jnp.float32(hd))
-            if self.causal:
-                mask = jnp.tril(jnp.ones((t, t), bool))
-                scores = jnp.where(
-                    mask[None, None], scores, jnp.float32(-1e30)
-                )
-            attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, v)
+            from ..ops.flash_attention import dense_attention
+
+            ctx = dense_attention(q, k, v, self.causal)
         ctx = ctx.reshape(b, t, h * hd)
         out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
         if self.use_bias:

@@ -184,7 +184,7 @@ class PipelinedBlocks(Layer):
         # doesn't silently all-gather the extra folds and recompute the
         # pipeline per-slice.
         row_axes = tuple(
-            a for a in getattr(strategy, "_row_axes", ())
+            a for a in getattr(strategy, "row_axes", ())
             if a in mesh.axis_names
         ) or (getattr(strategy, "axis", "data"),)
         n_data = 1

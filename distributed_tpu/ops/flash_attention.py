@@ -240,21 +240,29 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 _warned_backend = False
 
 
-def _dense_fallback(q, k, v, causal):
-    """Stock-XLA attention for backends with no Mosaic lowering: Pallas
-    interpret mode inside jit is orders of magnitude slower than the dense
-    einsums, so non-TPU accelerators (GPU) take this path with a warning
-    (CPU keeps interpret mode — that's the test configuration)."""
-    d = q.shape[-1]
+def dense_attention(q, k, v, causal: bool):
+    """Stock-XLA attention over (B, T, H, D) tensors — THE dense softmax
+    path, shared by MultiHeadAttention's short-T branch, the Ulysses
+    non-flash branch, and the no-Mosaic backend fallback below, so mask/
+    scale/dtype policy lives in exactly one place."""
+    hd = q.shape[-1]
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) / np.sqrt(d)
+    ) / jnp.sqrt(jnp.float32(hd))
     if causal:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None], s, jnp.float32(-1e30))
     a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", a, v)
+
+
+def _dense_fallback(q, k, v, causal):
+    """Backends with no Mosaic lowering: Pallas interpret mode inside jit is
+    orders of magnitude slower than the dense einsums, so non-TPU
+    accelerators (GPU) take the dense path with a warning (CPU keeps
+    interpret mode — that's the test configuration)."""
+    return dense_attention(q, k, v, causal)
 
 
 def flash_attention(

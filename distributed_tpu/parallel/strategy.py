@@ -152,6 +152,13 @@ class DataParallel(Strategy):
         # the other axes shard the model, not the batch.
         return int(self.mesh.shape[self.axis])
 
+    @property
+    def row_axes(self) -> tuple:
+        """Mesh axes the batch's row (leading) dim shards over. Consumers
+        outside this module (nn.PipelinedBlocks) read this instead of any
+        private attribute."""
+        return (self.axis,)
+
     def params_sharding(self, params):
         rep = NamedSharding(self.mesh, PartitionSpec())
         return jax.tree_util.tree_map(lambda _: rep, params)
@@ -663,6 +670,10 @@ class CompositeParallel(_HintedParallel):
         for a in self._row_axes:
             n *= int(self.mesh.shape[a])
         return n
+
+    @property
+    def row_axes(self) -> tuple:
+        return self._row_axes
 
     # -- parameter placement -------------------------------------------------
     def _role_spec(self, role: Optional[str], shape) -> PartitionSpec:

@@ -1,0 +1,168 @@
+"""File-backed streaming Pipeline (VERDICT round 2 item 6): a sharded-on-
+disk source behind the same C++ prefetch + seek + per-host sharding API,
+with determinism identical to the in-memory path — so ImageNet-scale data
+is feedable without the dataset resident in host RAM (the reference feeds
+whole datasets from memory, /root/reference/README.md:369-373)."""
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.data import FileSource, Pipeline, write_shards
+from distributed_tpu.data.pipeline import native_available
+
+
+def _make_shards(tmp_path, n=100, rows_per_shard=17, shape=(4, 3), seed=0,
+                 labels=True):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, (n,) + shape, dtype=np.uint8)
+    y = rng.integers(0, 10, (n,)).astype(np.int32) if labels else None
+    d = tmp_path / "shards"
+    write_shards(d, x, y, rows_per_shard=rows_per_shard)
+    return d, x, y
+
+
+class TestFileSource:
+    def test_shape_and_gather(self, tmp_path):
+        d, x, y = _make_shards(tmp_path)
+        src = FileSource(d)
+        assert len(src) == 100
+        assert src.row_shape == (4, 3)
+        assert len(src.x_shards) == 6  # ceil(100/17)
+        idx = np.array([0, 16, 17, 99, 50])  # spans shard boundaries
+        np.testing.assert_array_equal(src.gather(idx), x[idx])
+        np.testing.assert_array_equal(src.y, y)
+
+    def test_data_stays_memory_mapped(self, tmp_path):
+        """The larger-than-RAM property is structural: shards are np.memmap
+        views (OS pages them on demand), and the Pipeline holds NO host
+        copy of the dataset — only the per-batch slot buffers."""
+        d, _, _ = _make_shards(tmp_path, n=100)
+        src = FileSource(d)
+        assert all(isinstance(m, np.memmap) for m in src.x_shards)
+        p = Pipeline(src, None, 10, use_native=False)
+        assert p._x is None  # no concatenated in-RAM copy
+        next(p)
+        assert all(isinstance(m, np.memmap) for m in src.x_shards)
+
+    def test_errors(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            FileSource(tmp_path / "nope")
+        d = tmp_path / "empty"
+        d.mkdir()
+        with pytest.raises(FileNotFoundError, match="shard-"):
+            FileSource(d)
+        d2, x, _ = _make_shards(tmp_path)
+        with pytest.raises(FileExistsError):
+            write_shards(d2, x)
+        # partial labels are rejected (silent label misalignment otherwise)
+        d3, _, _ = _make_shards(tmp_path / "p", labels=True)
+        (d3 / "shard-00001-y.npy").unlink()
+        with pytest.raises(FileNotFoundError, match="partial"):
+            FileSource(d3)
+        with pytest.raises(TypeError, match="uint8"):
+            write_shards(tmp_path / "f32", np.zeros((4, 2), np.float32))
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+class TestStreamEquivalence:
+    def _impl(self, use_native):
+        if use_native and not native_available():
+            pytest.skip("no native pipeline")
+        return use_native
+
+    def test_matches_in_memory_stream(self, tmp_path, use_native):
+        """Same seed => the file-backed stream is bit-identical to the
+        in-memory stream over the concatenated array, shuffle included."""
+        use_native = self._impl(use_native)
+        d, x, y = _make_shards(tmp_path, n=96, rows_per_shard=13)
+        mem = Pipeline(x, y, 16, seed=7, use_native=use_native)
+        fil = Pipeline(FileSource(d), None, 16, seed=7,
+                       use_native=use_native)
+        assert fil.steps_per_pass == mem.steps_per_pass == 6
+        for _ in range(14):  # crosses pass boundaries (re-shuffles)
+            xa, ya = next(mem)
+            xb, yb = next(fil)
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+
+    def test_seek_resume(self, tmp_path, use_native):
+        use_native = self._impl(use_native)
+        d, _, _ = _make_shards(tmp_path, n=64, rows_per_shard=10)
+        a = Pipeline(FileSource(d), None, 8, seed=3, use_native=use_native)
+        for _ in range(5):
+            next(a)
+        want = [next(a) for _ in range(3)]
+        b = Pipeline(FileSource(d), None, 8, seed=3, use_native=use_native)
+        b.seek(5)
+        for wx, wy in want:
+            gx, gy = next(b)
+            np.testing.assert_array_equal(wx, gx)
+            np.testing.assert_array_equal(wy, gy)
+
+    def test_per_host_sharding(self, tmp_path, use_native):
+        """Host shards of the file-backed stream assemble into exactly the
+        unsharded batch (the per-host input contract)."""
+        use_native = self._impl(use_native)
+        d, _, _ = _make_shards(tmp_path, n=64, rows_per_shard=9)
+        full = Pipeline(FileSource(d), None, 16, seed=1,
+                        use_native=use_native)
+        parts = [
+            Pipeline(FileSource(d), None, 16, seed=1, shard=(i, 4),
+                     use_native=use_native)
+            for i in range(4)
+        ]
+        for _ in range(6):
+            fx, fy = next(full)
+            px = np.concatenate([next(p)[0] for p in parts])
+            np.testing.assert_array_equal(fx, px)
+
+    def test_path_accepted_directly(self, tmp_path, use_native):
+        use_native = self._impl(use_native)
+        d, x, y = _make_shards(tmp_path, n=32, rows_per_shard=8)
+        p = Pipeline(str(d), None, 8, shuffle=False, use_native=use_native)
+        xb, yb = next(p)
+        # Same op as the pipeline (multiply by 1/255, not divide by 255 —
+        # the two can differ in the last ulp).
+        np.testing.assert_array_equal(
+            xb, x[:8].astype(np.float32) * np.float32(1.0 / 255.0)
+        )
+        np.testing.assert_array_equal(yb, y[:8])
+
+
+def test_fit_trains_from_file_pipeline(devices, tmp_path):
+    """End to end: model.fit over a file-backed Pipeline learns separable
+    synthetic data — the ImageNet-shaped flow (BASELINE configs[3]) minus
+    the scale."""
+    x, y = dtpu.data.synthetic_images(512, (28, 28), 10, seed=5)
+    d = tmp_path / "mnist-shards"
+    write_shards(d, x[..., None], y, rows_per_shard=100)
+    with dtpu.DataParallel().scope():
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+        m.compile(optimizer=dtpu.optim.Adam(1e-3),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    pipe = Pipeline(FileSource(d), None, 64, seed=0)
+    hist = m.fit(pipe, epochs=4, verbose=0)
+    assert hist.history["accuracy"][-1] > 0.9, hist.history
+
+
+def test_shards_sort_numerically(tmp_path):
+    """shard-10 must follow shard-2 (lexicographic sort would reorder)."""
+    d = tmp_path / "unpadded"
+    d.mkdir()
+    for i, val in [(1, 1), (2, 2), (10, 10)]:
+        np.save(d / f"shard-{i}-x.npy",
+                np.full((4, 2), val, np.uint8))
+    src = FileSource(d)
+    got = src.gather(np.arange(12))[:, 0]
+    np.testing.assert_array_equal(got, [1] * 4 + [2] * 4 + [10] * 4)
+
+
+def test_fortran_order_shard_rejected(tmp_path):
+    d = tmp_path / "forder"
+    d.mkdir()
+    np.save(d / "shard-00000-x.npy",
+            np.asfortranarray(np.zeros((8, 4, 3), np.uint8)))
+    with pytest.raises(ValueError, match="contiguous"):
+        FileSource(d)
