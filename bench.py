@@ -244,35 +244,43 @@ def bench_resnet50(global_batch=256, image_size=224, warmup=3, measure=20,
 
 # ---------------------------------------------------------- transformer LM --
 def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
-                         d_model=768, num_heads=12, warmup=3, measure=20):
+                         d_model=768, num_heads=12, warmup=3, measure=20,
+                         with_remat_variant=True):
     """~136M-param LM (GPT-2-small shape, untied head), Pallas fused xent on
-    the 32k-vocab head."""
-    strategy = _strategy()
-    with strategy.scope():
-        model = dtpu.Model(
-            dtpu.models.transformer_lm(
-                vocab, num_layers=num_layers, d_model=d_model,
-                num_heads=num_heads, max_len=seq_len, dtype=jnp.bfloat16,
-            )
-        )
-        model.compile(
-            optimizer=dtpu.optim.Adam(1e-4),
-            loss="pallas_sparse_categorical_crossentropy",
-            metrics=["accuracy"],
-        )
-
+    the 32k-vocab head. Also reports a remat-policy variant (per-block
+    jax.checkpoint with dots_with_no_batch_dims_saveable) — the memory/
+    recompute trade long-context configs run with."""
     rng = np.random.default_rng(0)
     tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
-    model.build((seq_len,))
-    dev_batch = model.strategy.put_batch({
-        "x": tok[:, :-1].astype(np.int32),
-        "y": tok[:, 1:].astype(np.int32),
-    })
-    steps_per_sec = _time_steps(model, dev_batch, warmup, measure)
 
+    def run(**model_kw):
+        strategy = _strategy()
+        with strategy.scope():
+            model = dtpu.Model(
+                dtpu.models.transformer_lm(
+                    vocab, num_layers=num_layers, d_model=d_model,
+                    num_heads=num_heads, max_len=seq_len,
+                    dtype=jnp.bfloat16, **model_kw,
+                )
+            )
+            model.compile(
+                optimizer=dtpu.optim.Adam(1e-4),
+                loss="pallas_sparse_categorical_crossentropy",
+                metrics=["accuracy"],
+            )
+        model.build((seq_len,))
+        dev_batch = model.strategy.put_batch({
+            "x": tok[:, :-1].astype(np.int32),
+            "y": tok[:, 1:].astype(np.int32),
+        })
+        return model, _time_steps(model, dev_batch, warmup, measure)
+
+    model, steps_per_sec = run()
     n_params = sum(
         int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(model.params)
     )
+    del model  # free the base model's params/opt-state before the variant
+
     tokens = batch * seq_len
     d_ff = 4 * d_model
     # Analytic matmul FLOPs per token, forward: per block qkv+proj (8 d^2) +
@@ -283,7 +291,7 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
         + 2 * d_model * vocab
     )
     tflops = steps_per_sec * 3.0 * fwd_per_token * tokens / 1e12
-    return {
+    out = {
         "metric": f"transformer_lm_{n_params//1_000_000}M_train_steps_per_sec",
         "value": round(steps_per_sec, 3),
         "unit": "steps/s",
@@ -294,6 +302,19 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
         "tflops": round(tflops, 4),
         "mfu": _mfu(tflops),
     }
+    if with_remat_variant:
+        _, sps_remat = run(
+            remat=True,
+            remat_policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+        tfl_r = sps_remat * 3.0 * fwd_per_token * tokens / 1e12
+        out["remat_policy_variant"] = {
+            "policy": "dots_with_no_batch_dims_saveable",
+            "value": round(sps_remat, 3),
+            "tflops": round(tfl_r, 4),
+            "mfu": _mfu(tfl_r),
+        }
+    return out
 
 
 def main(modes=("mnist", "convergence", "resnet50", "lm")):

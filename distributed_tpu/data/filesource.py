@@ -93,6 +93,12 @@ class FileSource:
                     raise ValueError(
                         f"{p.name}: labels shape {arr.shape} != ({rows},)"
                     )
+                if not np.issubdtype(arr.dtype, np.integer):
+                    # Same strictness as the x-shard checks: a float label
+                    # file would otherwise be silently truncated to int32.
+                    raise TypeError(
+                        f"{p.name}: labels must be integer, got {arr.dtype}"
+                    )
             self.y: Optional[np.ndarray] = np.concatenate(parts).astype(
                 np.int32
             )

@@ -69,6 +69,7 @@ def transformer_lm(
     moe_every: int = 2,
     pipeline: bool = False,
     remat: bool = False,
+    remat_policy=None,
     flash="auto",
     dtype=None,
 ) -> nn.Sequential:
@@ -84,7 +85,9 @@ def transformer_lm(
     ``remat=True`` wraps every attention/FFN residual in ``nn.Remat`` —
     backward recomputes block activations instead of holding them in HBM
     (identical numerics and checkpoint paths, O(1)-blocks activation
-    memory).
+    memory). ``remat_policy`` forwards a ``jax.checkpoint_policies`` entry
+    (e.g. ``dots_with_no_batch_dims_saveable`` keeps matmul outputs and
+    recomputes only the elementwise chains).
     """
     d_ff = d_ff or 4 * d_model
     layers = [
@@ -102,7 +105,7 @@ def transformer_lm(
                     dtype=dtype,
                 )
             )
-            return nn.Remat(block) if remat else block
+            return nn.Remat(block, policy=remat_policy) if remat else block
 
         layers.append(nn.PipelinedBlocks(make_block, num_layers))
     else:
@@ -113,7 +116,8 @@ def transformer_lm(
                 flash=flash, dtype=dtype,
             )
             if remat:
-                block = [nn.Remat(residual) for residual in block]
+                block = [nn.Remat(residual, policy=remat_policy)
+                         for residual in block]
             layers += block
     layers += [nn.LayerNorm(), nn.Dense(vocab_size, dtype=dtype)]
     return nn.Sequential(layers, name="transformer_lm")

@@ -7,12 +7,13 @@ grid walks (batch*heads, q_blocks, kv_blocks) with the kv dimension
 innermost and sequential, carrying the running max/sum/accumulator in
 scratch, so HBM traffic is O(T*D) instead of O(T^2).
 
-The backward pass recomputes probabilities blockwise in plain JAX from the
-saved per-row statistics (m, l) — flash-style rematerialization; one scan
-over kv blocks yields dq/dk/dv without ever holding a full (T, T) matrix.
-XLA maps each block's matmuls onto the MXU, which is where all the FLOPs
-are; the Pallas win in the forward is fusing the softmax recurrence into
-the matmul stream.
+The backward pass is a pair of Pallas kernels (dq with the kv dimension
+innermost; dk/dv with the q dimension innermost) that recompute the
+probabilities in VMEM from the saved per-row statistics (m, l) —
+flash-style rematerialization; HBM traffic stays O(T*D) and no (T, T)
+matrix ever exists. (The first implementation was a plain-JAX blockwise
+scan; on the TPU it ran at ~12% MFU per layer because XLA serialized the
+kv-block loop as a while op — the kernels keep the MXU busy instead.)
 
 The reference has no attention anywhere (SURVEY.md §2c); this is part of the
 long-context tier the framework adds (with ops.ring_attention for the
@@ -161,55 +162,551 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
-    return out[:, :t, :d], m_out[:, :t, 0], l_out[:, :t, 0]
+    # m/l stay in their native padded (bh, t_pad, 128) kernel layout: the
+    # backward kernels read them directly as row-stat blocks, so saving
+    # them unsliced avoids a pad+broadcast round trip per backward.
+    return out[:, :t, :d], m_out, l_out
 
 
 # ----------------------------------------------------------------- backward
-def _bwd_blockwise(res, g, *, scale, causal, block_k):
-    """Blockwise dq/dk/dv from saved row stats. One scan over kv blocks;
-    peak extra memory is (T, block_k) per step instead of (T, T)."""
-    q, k, v, out, m_rows, l_rows = res
-    bh, t, d = q.shape
-    t_pad = _round_up(t, block_k)
-    nk = t_pad // block_k
-    kp = jnp.pad(k, ((0, 0), (0, t_pad - t), (0, 0)))
-    vp = jnp.pad(v, ((0, 0), (0, t_pad - t), (0, 0)))
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref, dq_ref,
+               acc_ref, *, scale, block_q, block_k, t_actual, causal, nk):
+    """dq for one (bh, qi, ki) grid step; ki sequential, acc in scratch.
 
-    qf = q.astype(jnp.float32)
-    gf = g.astype(jnp.float32)
-    # D_i = sum_j dO_ij * O_ij  (rowwise)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (bh, t)
-    m_b = m_rows[..., None]  # (bh, t, 1)
-    l_b = jnp.maximum(l_rows[..., None], 1e-30)
+    p is recomputed from the saved row statistics (m, l) flash-style —
+    never a (T, T) tensor in HBM; ds = p * (dO V^T - delta) * scale;
+    dq += ds K."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    row_ids = jnp.arange(t)[None, :, None]  # (1, t, 1)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def step(dq_acc, j):
-        kj = jax.lax.dynamic_slice_in_dim(kp, j * block_k, block_k, axis=1)
-        vj = jax.lax.dynamic_slice_in_dim(vp, j * block_k, block_k, axis=1)
-        kjf = kj.astype(jnp.float32)
-        vjf = vj.astype(jnp.float32)
-        s = jnp.einsum(
-            "btd,bkd->btk", qf, kjf, preferred_element_type=jnp.float32
-        ) * scale
-        col_ids = j * block_k + jnp.arange(block_k)[None, None, :]
-        valid = col_ids < t
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < t_actual
         if causal:
-            valid = jnp.logical_and(valid, col_ids <= row_ids)
-        p = jnp.where(valid, jnp.exp(s - m_b) / l_b, 0.0)  # (bh, t, bk)
-        dv_j = jnp.einsum("btk,btd->bkd", p, gf)
-        dp = jnp.einsum("btd,bkd->btk", gf, vjf)
-        ds = p * (dp - delta[..., None]) * scale
-        dk_j = jnp.einsum("btk,btd->bkd", ds, qf)
-        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, kjf)
-        return dq_acc, (dk_j, dv_j)
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        m = m_ref[0][:, :1]  # (bq, 1) f32
+        l = jnp.maximum(l_ref[0][:, :1], 1e-30)
+        p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        delta = dl_ref[0][:, :1]
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
-        step, jnp.zeros_like(qf), jnp.arange(nk)
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
+                dk_ref, dv_ref, acc_dk, acc_dv,
+                *, scale, block_q, block_k, t_actual, causal, nq):
+    """dk/dv for one (bh, ki, qi) grid step; qi sequential, accs in scratch.
+
+    dv += p^T dO; dk += ds^T q — both contractions over the q-block rows."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        acc_dk[...] = jnp.zeros_like(acc_dk)
+        acc_dv[...] = jnp.zeros_like(acc_dv)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (bq, bk)
+        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = col < t_actual
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        m = m_ref[0][:, :1]
+        l = jnp.maximum(l_ref[0][:, :1], 1e-30)
+        p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+        acc_dv[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        delta = dl_ref[0][:, :1]
+        ds = p * (dp - delta) * scale
+        acc_dk[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bk, d)
+
+    if causal:
+        # Skip q blocks entirely above the diagonal band (no row of this
+        # q block can see any column of this kv block).
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = acc_dk[...].astype(dk_ref.dtype)
+        dv_ref[0] = acc_dv[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(res, g, *, scale, causal, block_q, block_k):
+    """Pallas dq/dk/dv from the saved row stats: two kernels (dq with kv
+    innermost; dk/dv with q innermost), each O(T*D) HBM traffic."""
+    q, k, v, out, m_b, l_b = res  # m/l already (bh, t_pad, 128)
+    bh, t, d = q.shape
+    t_pad = _round_up(t, max(block_q, block_k))
+    d_pad = _round_up(max(d, 128), 128)
+    pad = lambda x: jnp.pad(x, ((0, 0), (0, t_pad - t), (0, d_pad - d)))
+    qp, kp, vp = pad(q), pad(k), pad(v)
+    dop = pad(g.astype(q.dtype))
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+
+    # delta_i = sum_j dO_ij O_ij, broadcast across lanes like m/l so the
+    # kernels read it as (1, block_q, 128) rows.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (bh, t)
+    dl_b = jnp.broadcast_to(
+        jnp.pad(delta, ((0, 0), (0, t_pad - t)))[..., None],
+        (bh, t_pad, 128),
     )
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, t_pad, d)[:, :t]
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    row_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            t_actual=t, causal=causal, nk=nk,
+        ),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            row_spec, row_spec, row_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, m_b, l_b, dl_b)
+
+    row_spec_kv = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            t_actual=t, causal=causal, nq=nq,
+        ),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, j, 0)),
+            row_spec_kv, row_spec_kv, row_spec_kv,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((bh, t_pad, d_pad), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, m_b, l_b, dl_b)
+    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
+
+
+# ------------------------------------------------- lane-packed (B,T,H*D) --
+# The folded kernels above take (B*H, T, D) and therefore need a
+# (B,T,H,D) -> (B,H,T,D) transpose around every call — profiled at
+# 25-30% of a GPT-2-small training step on v5e (the transposes run at
+# ~150 GB/s and there are ~8 per layer). The kernels below read the
+# attention heads straight out of the projection layout (B, T, H*D):
+# each 128-lane block holds 128//D whole heads side by side, the grid
+# walks (batch, head-block, q-block, kv-block), and the per-head math
+# slices lanes in VMEM. No HBM transpose exists in either direction.
+# Requires 128 % D == 0 and H % (128//D) == 0 (covers head_dim 64/128);
+# other shapes fall back to the folded path.
+
+_LANES = 128
+
+
+def _packed_supported(h: int, d: int) -> bool:
+    return d <= _LANES and _LANES % d == 0 and h % (_LANES // d) == 0
+
+
+def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
+                       m_ref, l_ref, acc_ref,
+                       *, scale, hd, block_q, block_k, t_actual, causal, nk):
+    """One (b, hblk, qi, ki) grid step on (1, block, 128) lane-packed tiles;
+    the 128 lanes hold 128//hd heads. Scratch m/l keep each head's running
+    stat replicated across that head's lane span."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0]  # (block_q, 128)
+        k = k_ref[0]  # (block_k, 128)
+        v = v_ref[0]
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = col < t_actual
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        for hx in range(_LANES // hd):
+            sl = slice(hx * hd, (hx + 1) * hd)
+            s = jax.lax.dot_general(
+                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (block_q, block_k)
+            s = jnp.where(valid, s, _NEG)
+            m_prev = m_ref[:, sl]  # (block_q, hd), lanes equal
+            l_prev = l_ref[:, sl]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(
+                m_prev, jnp.broadcast_to(m_cur, m_prev.shape)
+            )
+            alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+            p = jnp.exp(s - m_new[:, :1])
+            l_new = l_prev * alpha + jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), l_prev.shape
+            )
+            acc_ref[:, sl] = acc_ref[:, sl] * alpha + jnp.dot(
+                p.astype(v.dtype), v[:, sl],
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[:, sl] = m_new
+            l_ref[:, sl] = l_new
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        for hx in range(_LANES // hd):
+            sl = slice(hx * hd, (hx + 1) * hd)
+            o_ref[0, :, sl] = (
+                acc_ref[:, sl]
+                / jnp.maximum(l_ref[:, hx * hd : hx * hd + 1], 1e-30)
+            ).astype(o_ref.dtype)
+        m_out_ref[0, 0] = m_ref[...]
+        l_out_ref[0, 0] = l_ref[...]
+
+
+def _dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
+                      dq_ref, acc_ref,
+                      *, scale, hd, block_q, block_k, t_actual, causal, nk):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = col < t_actual
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        for hx in range(_LANES // hd):
+            sl = slice(hx * hd, (hx + 1) * hd)
+            s = jax.lax.dot_general(
+                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            m = m_ref[0, 0, :, hx * hd : hx * hd + 1]
+            l = jnp.maximum(l_ref[0, 0, :, hx * hd : hx * hd + 1], 1e-30)
+            p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+            dp = jax.lax.dot_general(
+                do[:, sl], v[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            delta = dl_ref[0, 0, :, hx * hd : hx * hd + 1]
+            ds = p * (dp - delta) * scale
+            acc_ref[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k[:, sl], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
+                       dk_ref, dv_ref, acc_dk, acc_dv,
+                       *, scale, hd, block_q, block_k, t_actual, causal, nq):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        acc_dk[...] = jnp.zeros_like(acc_dk)
+        acc_dv[...] = jnp.zeros_like(acc_dv)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        col = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        valid = col < t_actual
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            valid = jnp.logical_and(valid, col <= row)
+        for hx in range(_LANES // hd):
+            sl = slice(hx * hd, (hx + 1) * hd)
+            s = jax.lax.dot_general(
+                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            m = m_ref[0, 0, :, hx * hd : hx * hd + 1]
+            l = jnp.maximum(l_ref[0, 0, :, hx * hd : hx * hd + 1], 1e-30)
+            p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+            acc_dv[:, sl] += jax.lax.dot_general(
+                p.astype(do.dtype), do[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do[:, sl], v[:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            delta = dl_ref[0, 0, :, hx * hd : hx * hd + 1]
+            ds = p * (dp - delta) * scale
+            acc_dk[:, sl] += jax.lax.dot_general(
+                ds.astype(q.dtype), q[:, sl], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = acc_dk[...].astype(dk_ref.dtype)
+        dv_ref[0] = acc_dv[...].astype(dv_ref.dtype)
+
+
+def _fwd_pallas_packed(qf, kf, vf, h, d, scale, causal, block_q, block_k):
+    """qf,kf,vf: (B, T, H*D) lane-packed. Returns (out, m, l) with out in
+    the same layout and m/l: (B, H//hpb, t_pad, 128)."""
+    b, t, _ = qf.shape
+    t_pad = _round_up(t, max(block_q, block_k))
+    pad = lambda x: jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    qp, kp, vp = pad(qf), pad(kf), pad(vf)
+    hpb = _LANES // d
+    nh = h // hpb
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel_packed, scale=scale, hd=d, block_q=block_q,
+        block_k=block_k, t_actual=t, causal=causal, nk=nk,
+    )
+    lane_q = pl.BlockSpec((1, block_q, _LANES), lambda b, h, i, j: (b, i, h))
+    lane_k = pl.BlockSpec((1, block_k, _LANES), lambda b, h, i, j: (b, j, h))
+    stat = pl.BlockSpec((1, 1, block_q, _LANES),
+                        lambda b, h, i, j: (b, h, i, 0))
+    out, m_out, l_out = pl.pallas_call(
+        kernel,
+        grid=(b, nh, nq, nk),
+        in_specs=[lane_q, lane_k, lane_k],
+        out_specs=[lane_q, stat, stat],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_pad, h * d), qf.dtype),
+            jax.ShapeDtypeStruct((b, nh, t_pad, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, nh, t_pad, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :t], m_out, l_out
+
+
+def _bwd_pallas_packed(h, d, causal, block_q, block_k, res, g):
+    qf, kf, vf, out, m_out, l_out = res
+    b, t, _ = qf.shape
+    scale = 1.0 / np.sqrt(d)
+    t_pad = _round_up(t, max(block_q, block_k))
+    pad = lambda x: jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
+    qp, kp, vp = pad(qf), pad(kf), pad(vf)
+    dop = pad(g.astype(qf.dtype))
+    hpb = _LANES // d
+    nh = h // hpb
+    nq = t_pad // block_q
+    nk = t_pad // block_k
+
+    # delta per (b, t, head) -> the (b, nh, t_pad, 128) stat layout with
+    # each head's value replicated across its lane span.
+    gf = g.astype(jnp.float32).reshape(b, t, h, d)
+    of = out.astype(jnp.float32).reshape(b, t, h, d)
+    delta = jnp.sum(gf * of, axis=-1)  # (b, t, h)
+    delta = jnp.repeat(
+        delta.reshape(b, t, nh, hpb), d, axis=-1
+    )  # (b, t, nh, 128)
+    delta = jnp.moveaxis(delta, 2, 1)  # (b, nh, t, 128) — tiny tensor
+    delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
+
+    lane_q = pl.BlockSpec((1, block_q, _LANES), lambda b, h, i, j: (b, i, h))
+    lane_k = pl.BlockSpec((1, block_k, _LANES), lambda b, h, i, j: (b, j, h))
+    stat_q = pl.BlockSpec((1, 1, block_q, _LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel_packed, scale=scale, hd=d, block_q=block_q,
+            block_k=block_k, t_actual=t, causal=causal, nk=nk,
+        ),
+        grid=(b, nh, nq, nk),
+        in_specs=[lane_q, lane_k, lane_k, lane_q, stat_q, stat_q, stat_q],
+        out_specs=lane_q,
+        out_shape=jax.ShapeDtypeStruct((b, t_pad, h * d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, _LANES), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, m_out, l_out, delta)
+
+    lane_q_kv = pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, h, i, j: (b, j, h))
+    lane_k_kv = pl.BlockSpec((1, block_k, _LANES),
+                             lambda b, h, i, j: (b, i, h))
+    stat_kv = pl.BlockSpec((1, 1, block_q, _LANES),
+                           lambda b, h, i, j: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel_packed, scale=scale, hd=d, block_q=block_q,
+            block_k=block_k, t_actual=t, causal=causal, nq=nq,
+        ),
+        grid=(b, nh, nk, nq),
+        in_specs=[lane_q_kv, lane_k_kv, lane_k_kv, lane_q_kv,
+                  stat_kv, stat_kv, stat_kv],
+        out_specs=[lane_k_kv, lane_k_kv],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_pad, h * d), kf.dtype),
+            jax.ShapeDtypeStruct((b, t_pad, h * d), vf.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, _LANES), jnp.float32),
+            pltpu.VMEM((block_k, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, m_out, l_out, delta)
+    return dq[:, :t], dk[:, :t], dv[:, :t]
+
+
+def _make_packed(h, d, causal, block_q, block_k):
+    """custom_vjp fn over (B, T, H*D) arrays for this static config."""
+
+    @jax.custom_vjp
+    def packed(qf, kf, vf):
+        scale = 1.0 / np.sqrt(d)
+        out, _, _ = _fwd_pallas_packed(
+            qf, kf, vf, h, d, scale, causal, block_q, block_k
+        )
+        return out
+
+    def fwd(qf, kf, vf):
+        scale = 1.0 / np.sqrt(d)
+        out, m_out, l_out = _fwd_pallas_packed(
+            qf, kf, vf, h, d, scale, causal, block_q, block_k
+        )
+        return out, (qf, kf, vf, out, m_out, l_out)
+
+    packed.defvjp(fwd, functools.partial(
+        _bwd_pallas_packed, h, d, causal, block_q, block_k
+    ))
+    return packed
+
+
+@functools.lru_cache(maxsize=64)
+def _packed_cached(h, d, causal, block_q, block_k):
+    return _make_packed(h, d, causal, block_q, block_k)
 
 
 # -------------------------------------------------------------------- public
@@ -230,8 +727,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
 
 def _flash_bwd(causal, block_q, block_k, res, g):
     scale = 1.0 / np.sqrt(res[0].shape[-1])
-    return _bwd_blockwise(res, g, scale=scale, causal=causal,
-                          block_k=block_k)
+    return _bwd_pallas(res, g, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -290,11 +787,19 @@ def flash_attention(
             _warned_backend = True
         return _dense_fallback(q, k, v, causal)
     b, t, h, d = q.shape
-    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
     rt = _round_up(t, 8)
     bq = min(block_q, rt)
     bk = min(block_k, rt)
     if max(bq, bk) % min(bq, bk):  # clamping broke divisibility
         bq = bk = min(bq, bk)
+    if _packed_supported(h, d):
+        # Lane-packed path: kernels read heads straight from the (B, T,
+        # H*D) projection layout — the reshape is free, no transposes.
+        packed = _packed_cached(h, d, causal, bq, bk)
+        return packed(
+            q.reshape(b, t, h * d), k.reshape(b, t, h * d),
+            v.reshape(b, t, h * d),
+        ).reshape(b, t, h, d)
+    fold = lambda x: jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
     out = _flash(fold(q), fold(k), fold(v), causal, bq, bk)
     return jnp.moveaxis(out.reshape(b, h, t, d), 1, 2)

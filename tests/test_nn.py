@@ -105,3 +105,27 @@ def test_embedding_and_layernorm():
     p, _, _ = ln.init(jax.random.PRNGKey(0), (16,))
     z, _ = ln.apply(p, {}, y)
     assert jnp.abs(jnp.mean(z)) < 1e-4
+
+
+def test_batchnorm_stats_match_f32_reference():
+    """The accumulating-reduction form (no materialized f32 activation
+    copy) must produce the same f32 statistics as the naive cast-first
+    computation, including on bf16 inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((64, 7, 7, 32)) * 3 + 1.5).astype(np.float32)
+    for dtype in (jnp.float32, jnp.bfloat16):
+        xd = jnp.asarray(x, dtype)
+        bn = nn.BatchNorm()
+        params, state, _ = bn.init(jax.random.PRNGKey(0), (7, 7, 32))
+        _, new_state = bn.apply(params, state, xd, train=True)
+        xf = np.asarray(xd, np.float32)
+        want_mean = xf.mean(axis=(0, 1, 2))
+        want_var = xf.var(axis=(0, 1, 2))
+        got_mean = (np.asarray(new_state["mean"]) - 0.9 * 0.0) / 0.1
+        got_var = (np.asarray(new_state["var"]) - 0.9 * 1.0) / 0.1
+        np.testing.assert_allclose(got_mean, want_mean, rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(got_var, want_var, rtol=2e-2, atol=2e-2)
+        assert (got_var >= 0).all()
