@@ -47,6 +47,40 @@ def _round_up(v: int, m: int) -> int:
 _NEG = -1e30
 
 
+# -------------------------------------------------- shared kernel helpers --
+def _valid_mask(qi, ki, block_q, block_k, t_actual, causal):
+    """(block_q, block_k) mask: real columns, and under causality the
+    lower-triangular band for this (qi, ki) block pair. The single source
+    of truth for masking across all six kernels (folded + packed)."""
+    col = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    valid = col < t_actual
+    if causal:
+        row = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        valid = jnp.logical_and(valid, col <= row)
+    return valid
+
+
+def _p_ds(q, k, v, do, m, l, delta, valid, scale):
+    """Backward-pass block math shared by all dq/dk/dv kernels: recompute
+    p from the saved row stats (flash-style), then ds = p*(dO V^T -
+    delta)*scale. q/do: (bq, d); k/v: (bk, d); m/l/delta: (bq, 1)."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    p = jnp.where(valid, jnp.exp(s - m) / jnp.maximum(l, 1e-30), 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta) * scale
+    return p, ds
+
+
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
                 m_ref, l_ref, acc_ref,
@@ -75,13 +109,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
             preferred_element_type=jnp.float32,
         ) * scale  # (block_q, block_k) f32
 
-        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
         s = jnp.where(valid, s, _NEG)
 
         m_prev = m_ref[...]  # (block_q, 128), all lanes equal
@@ -162,10 +190,11 @@ def _fwd_pallas(q, k, v, scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
-    # m/l stay in their native padded (bh, t_pad, 128) kernel layout: the
-    # backward kernels read them directly as row-stat blocks, so saving
-    # them unsliced avoids a pad+broadcast round trip per backward.
-    return out[:, :t, :d], m_out, l_out
+    # Residual stats are sliced to one value per row: the lane-replicated
+    # (bh, t_pad, 128) kernel form is 128x larger and would dominate
+    # forward->backward residual memory at long T; the backward
+    # re-broadcasts transiently instead.
+    return out[:, :t, :d], m_out[:, :t, 0], l_out[:, :t, 0]
 
 
 # ----------------------------------------------------------------- backward
@@ -184,30 +213,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref, dq_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def compute():
-        q = q_ref[0]
         k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
-        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
-        m = m_ref[0][:, :1]  # (bq, 1) f32
-        l = jnp.maximum(l_ref[0][:, :1], 1e-30)
-        p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (bq, bk)
-        delta = dl_ref[0][:, :1]
-        ds = p * (dp - delta) * scale
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
+        _, ds = _p_ds(
+            q_ref[0], k, v_ref[0], do_ref[0],
+            m_ref[0][:, :1], l_ref[0][:, :1], dl_ref[0][:, :1],
+            valid, scale,
+        )
         acc_ref[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -241,33 +253,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
 
     def compute():
         q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
         do = do_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (bq, bk)
-        col = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
-        m = m_ref[0][:, :1]
-        l = jnp.maximum(l_ref[0][:, :1], 1e-30)
-        p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
+        p, ds = _p_ds(
+            q, k_ref[0], v_ref[0], do,
+            m_ref[0][:, :1], l_ref[0][:, :1], dl_ref[0][:, :1],
+            valid, scale,
+        )
         acc_dv[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bk, d)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        delta = dl_ref[0][:, :1]
-        ds = p * (dp - delta) * scale
         acc_dk[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -291,7 +287,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
 def _bwd_pallas(res, g, *, scale, causal, block_q, block_k):
     """Pallas dq/dk/dv from the saved row stats: two kernels (dq with kv
     innermost; dk/dv with q innermost), each O(T*D) HBM traffic."""
-    q, k, v, out, m_b, l_b = res  # m/l already (bh, t_pad, 128)
+    q, k, v, out, m_rows, l_rows = res  # m/l: (bh, t)
     bh, t, d = q.shape
     t_pad = _round_up(t, max(block_q, block_k))
     d_pad = _round_up(max(d, 128), 128)
@@ -301,15 +297,20 @@ def _bwd_pallas(res, g, *, scale, causal, block_q, block_k):
     nq = t_pad // block_q
     nk = t_pad // block_k
 
-    # delta_i = sum_j dO_ij O_ij, broadcast across lanes like m/l so the
-    # kernels read it as (1, block_q, 128) rows.
+    # delta_i = sum_j dO_ij O_ij; m/l/delta broadcast across lanes into
+    # the kernels' (1, block_q, 128) row-stat form (transient buffers —
+    # only the (bh, t) stats are held as residuals from the forward).
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # (bh, t)
-    dl_b = jnp.broadcast_to(
-        jnp.pad(delta, ((0, 0), (0, t_pad - t)))[..., None],
-        (bh, t_pad, 128),
-    )
+
+    def rowstat(x):
+        return jnp.broadcast_to(
+            jnp.pad(x, ((0, 0), (0, t_pad - t)))[..., None],
+            (bh, t_pad, 128),
+        )
+
+    m_b, l_b, dl_b = rowstat(m_rows), rowstat(l_rows), rowstat(delta)
 
     row_spec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
@@ -376,6 +377,13 @@ def _bwd_pallas(res, g, *, scale, causal, block_q, block_k):
 
 _LANES = 128
 
+# Sequence length (padded) above which the packed kernels save their row
+# stats compactly ((b, nh, t_pad, heads_per_block)) and re-expand in the
+# backward: the lane-replicated form reads fastest under Mosaic but costs
+# 128/heads_per_block x the residual memory, which only matters once T is
+# long enough for stats to rival the activations themselves.
+_COMPACT_STATS_MIN_T = 2048
+
 
 def _packed_supported(h: int, d: int) -> bool:
     return d <= _LANES and _LANES % d == 0 and h % (_LANES // d) == 0
@@ -400,15 +408,7 @@ def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,
         q = q_ref[0]  # (block_q, 128)
         k = k_ref[0]  # (block_k, 128)
         v = v_ref[0]
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
         for hx in range(_LANES // hd):
             sl = slice(hx * hd, (hx + 1) * hd)
             s = jax.lax.dot_general(
@@ -468,30 +468,16 @@ def _dq_kernel_packed(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
         for hx in range(_LANES // hd):
             sl = slice(hx * hd, (hx + 1) * hd)
-            s = jax.lax.dot_general(
-                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            m = m_ref[0, 0, :, hx * hd : hx * hd + 1]
-            l = jnp.maximum(l_ref[0, 0, :, hx * hd : hx * hd + 1], 1e-30)
-            p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
-            dp = jax.lax.dot_general(
-                do[:, sl], v[:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+            _, ds = _p_ds(
+                q[:, sl], k[:, sl], v[:, sl], do[:, sl],
+                m_ref[0, 0, :, hx * hd : hx * hd + 1],
+                l_ref[0, 0, :, hx * hd : hx * hd + 1],
+                dl_ref[0, 0, :, hx * hd : hx * hd + 1],
+                valid, scale,
             )
-            delta = dl_ref[0, 0, :, hx * hd : hx * hd + 1]
-            ds = p * (dp - delta) * scale
             acc_ref[:, sl] += jax.lax.dot_general(
                 ds.astype(k.dtype), k[:, sl], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -525,34 +511,20 @@ def _dkv_kernel_packed(q_ref, k_ref, v_ref, do_ref, m_ref, l_ref, dl_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        col = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        valid = col < t_actual
-        if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            valid = jnp.logical_and(valid, col <= row)
+        valid = _valid_mask(qi, ki, block_q, block_k, t_actual, causal)
         for hx in range(_LANES // hd):
             sl = slice(hx * hd, (hx + 1) * hd)
-            s = jax.lax.dot_general(
-                q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            m = m_ref[0, 0, :, hx * hd : hx * hd + 1]
-            l = jnp.maximum(l_ref[0, 0, :, hx * hd : hx * hd + 1], 1e-30)
-            p = jnp.where(valid, jnp.exp(s - m) / l, 0.0)
+            p, ds = _p_ds(
+                q[:, sl], k[:, sl], v[:, sl], do[:, sl],
+                m_ref[0, 0, :, hx * hd : hx * hd + 1],
+                l_ref[0, 0, :, hx * hd : hx * hd + 1],
+                dl_ref[0, 0, :, hx * hd : hx * hd + 1],
+                valid, scale,
+            )
             acc_dv[:, sl] += jax.lax.dot_general(
                 p.astype(do.dtype), do[:, sl], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            dp = jax.lax.dot_general(
-                do[:, sl], v[:, sl], (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            delta = dl_ref[0, 0, :, hx * hd : hx * hd + 1]
-            ds = p * (dp - delta) * scale
             acc_dk[:, sl] += jax.lax.dot_general(
                 ds.astype(q.dtype), q[:, sl], (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -608,11 +580,16 @@ def _fwd_pallas_packed(qf, kf, vf, h, d, scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
+    if t_pad >= _COMPACT_STATS_MIN_T:
+        # Long context: slice to one value per head per row (the 128-lane
+        # block holds 128//d heads, each replicated over its d-lane span)
+        # so the residual is 1/d the size; the backward re-expands.
+        return out[:, :t], m_out[..., ::d], l_out[..., ::d]
     return out[:, :t], m_out, l_out
 
 
 def _bwd_pallas_packed(h, d, causal, block_q, block_k, res, g):
-    qf, kf, vf, out, m_out, l_out = res
+    qf, kf, vf, out, m_rows, l_rows = res  # m/l: (b, nh, t_pad)
     b, t, _ = qf.shape
     scale = 1.0 / np.sqrt(d)
     t_pad = _round_up(t, max(block_q, block_k))
@@ -624,6 +601,14 @@ def _bwd_pallas_packed(h, d, causal, block_q, block_k, res, g):
     nq = t_pad // block_q
     nk = t_pad // block_k
 
+    # Short-T residuals arrive lane-replicated (fastest Mosaic reads);
+    # long-T residuals arrive compact and are re-expanded transiently.
+    if m_rows.shape[-1] == _LANES:
+        m_out, l_out = m_rows, l_rows
+    else:
+        m_out = jnp.repeat(m_rows, d, axis=-1)  # (b, nh, t_pad, 128)
+        l_out = jnp.repeat(l_rows, d, axis=-1)
+
     # delta per (b, t, head) -> the (b, nh, t_pad, 128) stat layout with
     # each head's value replicated across its lane span.
     gf = g.astype(jnp.float32).reshape(b, t, h, d)
@@ -632,7 +617,7 @@ def _bwd_pallas_packed(h, d, causal, block_q, block_k, res, g):
     delta = jnp.repeat(
         delta.reshape(b, t, nh, hpb), d, axis=-1
     )  # (b, t, nh, 128)
-    delta = jnp.moveaxis(delta, 2, 1)  # (b, nh, t, 128) — tiny tensor
+    delta = jnp.moveaxis(delta, 2, 1)  # (b, nh, t, 128) — small tensor
     delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - t), (0, 0)))
 
     lane_q = pl.BlockSpec((1, block_q, _LANES), lambda b, h, i, j: (b, i, h))

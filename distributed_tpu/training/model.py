@@ -271,7 +271,8 @@ class Model:
         batch_size: int = 32,
         epochs: int = 1,
         steps_per_epoch: Optional[int] = None,
-        validation_data: Optional[Tuple] = None,
+        validation_data=None,
+        validation_steps: Optional[int] = None,
         shuffle: bool = True,
         verbose: int = 1,
         initial_epoch: int = 0,
@@ -408,10 +409,19 @@ class Model:
                 c = sum(p[1] for p in pairs)
                 logs[name] = float(s / max(c, 1.0))
             if validation_data is not None:
-                val = self.evaluate(
-                    validation_data[0], validation_data[1],
-                    batch_size=batch_size, verbose=0,
-                )
+                # Arrays as (x, y); anything with __next__ (a Pipeline or
+                # plain batch iterator) is consumed for validation_steps
+                # batches (default: one pass) — the ImageNet-shaped flow
+                # can validate from an iterator, not just host arrays.
+                if hasattr(validation_data, "__next__"):
+                    val = self.evaluate(
+                        validation_data, steps=validation_steps, verbose=0
+                    )
+                else:
+                    val = self.evaluate(
+                        validation_data[0], validation_data[1],
+                        batch_size=batch_size, verbose=0,
+                    )
                 logs.update({f"val_{k}": v for k, v in val.items()})
             dt = time.perf_counter() - t0
             history.record(epoch, logs)
@@ -435,7 +445,25 @@ class Model:
         return history
 
     # --------------------------------------------------------------- evaluate
-    def evaluate(self, x, y, batch_size: int = 32, verbose: int = 1) -> Dict[str, float]:
+    def evaluate(self, x, y=None, batch_size: int = 32, verbose: int = 1,
+                 steps: Optional[int] = None) -> Dict[str, float]:
+        """Evaluate on arrays ``(x, y)`` or on a batch iterator.
+
+        Iterator form: ``evaluate(pipe)`` where ``pipe`` yields ``(x, y)``
+        batches (e.g. ``data.Pipeline``, including per-host sharded ones).
+        ``steps`` gives the number of batches to consume; defaults to the
+        source's ``steps_per_pass`` (one pass) when it has one. The
+        iterator is advanced, not reset — each call evaluates the next
+        ``steps`` batches of the stream.
+        """
+        if y is None:
+            if hasattr(x, "__next__"):
+                return self._evaluate_iterator(x, steps=steps,
+                                               verbose=verbose)
+            raise TypeError(
+                "evaluate() needs (x, y) arrays or a batch iterator "
+                f"yielding (x, y); got {type(x).__name__} without labels"
+            )
         x = np.asarray(x)
         y = np.asarray(y)
         if not (self.built and self.compiled):
@@ -461,6 +489,49 @@ class Model:
             results.append(
                 step_fn(self.params, self.state, batch["x"], batch["y"], batch["m"])
             )
+        return self._finish_eval(results, n, verbose)
+
+    def _evaluate_iterator(self, source, *, steps=None, verbose=1):
+        if not (self.built and self.compiled):
+            raise RuntimeError("Model must be built and compiled")
+        if steps is None:
+            steps = getattr(source, "steps_per_pass", None)
+            if steps is None:
+                raise ValueError(
+                    "steps is required when evaluating from a plain "
+                    "iterator (sources with steps_per_pass, e.g. "
+                    "data.Pipeline, default to one pass)"
+                )
+        # A sharded Pipeline emits only this host's rows of each batch.
+        per_host = getattr(source, "shard", None) is not None
+        step_fn = self._get_eval_step()
+        results = []
+        rows = 0
+        for step_i in range(int(steps)):
+            try:
+                xb, yb = next(source)
+            except StopIteration:
+                raise ValueError(
+                    f"validation iterator exhausted after {step_i} of "
+                    f"{int(steps)} batches — a finite iterator cannot be "
+                    "re-consumed across epochs; use a repeating source "
+                    "(data.Pipeline) or pass a smaller steps/"
+                    "validation_steps"
+                ) from None
+            mask = np.ones((xb.shape[0],), np.float32)
+            batch = self.strategy.put_batch(
+                {"x": xb, "y": yb, "m": mask}, per_host=per_host
+            )
+            results.append(
+                step_fn(self.params, self.state, batch["x"], batch["y"],
+                        batch["m"])
+            )
+            rows += xb.shape[0]
+        n = getattr(source, "batch_size", None)
+        n = n * int(steps) if (per_host and n) else rows
+        return self._finish_eval(results, n, verbose)
+
+    def _finish_eval(self, results, n, verbose):
         results = jax.device_get(results)
         loss_sum = sum(float(r[0]) for r in results)
         count = sum(float(r[1]) for r in results)

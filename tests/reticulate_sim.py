@@ -436,9 +436,8 @@ class RBinding:
     # model.R:57-79
     def fit(self, object, x, y, batch_size=r_int(32), epochs=r_int(1),
             steps_per_epoch=NULL, validation_data=NULL, verbose=r_int(1),
-            callbacks=None):
-        if callbacks is None:
-            callbacks = RList([])
+            callbacks=RList([])):
+        # default mirrors model.R's `callbacks = list()` (read-only here)
         h = object.attr("fit")(
             x, y,
             batch_size=as_integer(batch_size),

@@ -195,3 +195,27 @@ def test_packed_layout_matches_dense_values_and_grads(shape, causal):
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_packed_compact_stats_branch_matches(monkeypatch):
+    """Long-context residual policy: above _COMPACT_STATS_MIN_T the packed
+    path saves compact per-head stats and re-expands in backward — values
+    and grads must be identical to the short-T (lane-replicated) branch."""
+    from distributed_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv((1, 96, 2, 64), seed=5)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        return jnp.sum(jnp.sin(o))
+
+    g_fast = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    fa._packed_cached.cache_clear()  # static config changed: force retrace
+    monkeypatch.setattr(fa, "_COMPACT_STATS_MIN_T", 32)
+    try:
+        g_compact = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa._packed_cached.cache_clear()
+    for a, b in zip(g_fast, g_compact):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)

@@ -1,0 +1,83 @@
+"""Multi-slice (DCN) hybrid mesh construction (VERDICT r2 item 10):
+make_mesh(..., dcn_axis='data') lays the data axis across slices so only
+the gradient all-reduce crosses DCN while model/fsdp axes stay on a
+slice's ICI. The 8-device sim mocks a 2-slice system via slice_ids."""
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.parallel.mesh import _hybrid_device_array, make_mesh
+
+
+def _mock_slices(devices, per_slice):
+    return [i // per_slice for i in range(len(devices))]
+
+
+def test_data_axis_lays_across_slices(devices):
+    ids = _mock_slices(devices, 4)  # two "slices" of 4 devices
+    mesh = make_mesh({"data": 4, "model": 2}, devices=devices,
+                     dcn_axis="data", slice_ids=ids)
+    assert mesh.axis_names == ("data", "model")
+    slice_of = {d.id: s for d, s in zip(devices, ids)}
+    # Along 'data': first half slice 0, second half slice 1.
+    arr = mesh.devices
+    for di in range(4):
+        expect = 0 if di < 2 else 1
+        for mi in range(2):
+            assert slice_of[arr[di, mi].id] == expect, (di, mi)
+    # Along 'model' (the ICI axis): never crosses a slice boundary.
+    for di in range(4):
+        assert len({slice_of[arr[di, mi].id] for mi in range(2)}) == 1
+
+
+def test_fsdp_within_slice_data_across(devices):
+    ids = _mock_slices(devices, 4)
+    mesh = make_mesh({"data": 2, "fsdp": 4}, devices=devices,
+                     dcn_axis="data", slice_ids=ids)
+    slice_of = {d.id: s for d, s in zip(devices, ids)}
+    arr = mesh.devices
+    for di in range(2):
+        spans = {slice_of[arr[di, fi].id] for fi in range(4)}
+        assert spans == {di}, spans  # whole fsdp line inside one slice
+
+
+def test_single_slice_ignores_dcn_axis(devices):
+    mesh = make_mesh({"data": 8}, devices=devices, dcn_axis="data")
+    assert mesh.shape["data"] == 8  # plain path, no error
+
+
+def test_errors(devices):
+    ids = _mock_slices(devices, 4)
+    with pytest.raises(ValueError, match="not among"):
+        make_mesh({"data": 8}, devices=devices, dcn_axis="model",
+                  slice_ids=ids)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_mesh({"data": 1, "model": 8}, devices=devices,
+                  dcn_axis="data", slice_ids=ids)
+    with pytest.raises(ValueError, match="slice_ids"):
+        make_mesh({"data": 8}, devices=devices, dcn_axis="data",
+                  slice_ids=[0, 1])
+    # Unbalanced slices are rejected, not silently misarranged.
+    bad = [0] * 3 + [1] * 5
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh({"data": 2, "model": 4}, devices=devices,
+                  dcn_axis="data", slice_ids=bad)
+
+
+def test_strategy_over_hybrid_mesh_trains(devices):
+    """A DataTensorParallel strategy on the hybrid mesh runs a real train
+    step (the v4-64-shaped config: data across slices, model within)."""
+    ids = _mock_slices(devices, 4)
+    mesh = make_mesh({"data": 4, "model": 2}, devices=devices,
+                     dcn_axis="data", slice_ids=ids)
+    strategy = dtpu.DataTensorParallel(mesh=mesh)
+    with strategy.scope():
+        m = dtpu.Model(dtpu.models.transformer_lm(
+            32, num_layers=1, d_model=32, num_heads=4, max_len=16))
+        m.compile(optimizer=dtpu.optim.Adam(1e-2),
+                  loss="sparse_categorical_crossentropy")
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 32, (8, 17)).astype(np.int32)
+    hist = m.fit(tok[:, :-1], tok[:, 1:], batch_size=8, epochs=2, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
