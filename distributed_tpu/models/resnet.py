@@ -81,6 +81,8 @@ def resnet(
     small_inputs: bool = False,
     stage_blocks: Optional[Sequence[int]] = None,
     width: int = 64,
+    stem: str = "conv7",
+    scan_stages: bool = False,
     dtype=None,
 ) -> nn.Sequential:
     if depth not in _CONFIGS:
@@ -90,20 +92,52 @@ def resnet(
     make = _basic_block if kind == "basic" else _bottleneck_block
     expansion = 1 if kind == "basic" else 4
 
+    if stem not in ("conv7", "space_to_depth"):
+        raise ValueError(
+            f"Unknown stem {stem!r}; choose 'conv7' or 'space_to_depth'"
+        )
     if small_inputs:  # CIFAR-style stem
+        if stem != "conv7":
+            raise ValueError(
+                "small_inputs=True uses the CIFAR 3x3 stem; it is "
+                f"incompatible with stem={stem!r}"
+            )
         layers = _conv_bn(width, 3, activation="relu", dtype=dtype)
-    else:  # ImageNet stem
+    elif stem == "space_to_depth":
+        # TPU stem: space-to-depth(2) then a 4x4/1 conv on 12 channels.
+        # Same downsampling and output shape as conv7 (112x112xW before the
+        # pool), but the conv packs 12 input channels onto the MXU's lanes
+        # instead of 3 — the 7x7/2 RGB conv is the classic layout-hostile
+        # TPU stem. An unconstrained 4x4x12 kernel spans an 8x8 RGB
+        # receptive field (superset of the padded 7x7), so this is a
+        # reparametrization, not an approximation.
+        layers = [nn.SpaceToDepth(2)]
+        layers += _conv_bn(width, 4, activation="relu", dtype=dtype)
+        layers.append(nn.MaxPool2D(3, strides=2, padding="same"))
+    else:  # "conv7": the reference-style ImageNet stem
         layers = _conv_bn(width, 7, strides=2, activation="relu", dtype=dtype)
         layers.append(nn.MaxPool2D(3, strides=2, padding="same"))
 
     in_ch = width
     for stage, n_blocks in enumerate(blocks):
         filters = _STAGE_WIDTHS[stage] * width // 64
-        for b in range(n_blocks):
-            strides = 2 if (b == 0 and stage > 0) else 1
-            project = b == 0 and (strides != 1 or in_ch != filters * expansion)
-            layers.append(make(filters, strides, project, dtype))
-            in_ch = filters * expansion
+        first_strides = 2 if stage > 0 else 1
+        project = first_strides != 1 or in_ch != filters * expansion
+        layers.append(make(filters, first_strides, project, dtype))
+        in_ch = filters * expansion
+        tail = n_blocks - 1
+        if tail > 0 and scan_stages:
+            # The tail blocks of a stage are structurally identical and
+            # shape-preserving: run them as ONE weight-stacked lax.scan so
+            # static op count (and the optimizer's per-tensor update ops)
+            # stay depth-independent — the unrolled form is op-dispatch-
+            # bound on TPU before it is FLOP-bound.
+            layers.append(nn.ScannedBlocks(
+                lambda f=filters: make(f, 1, False, dtype), tail,
+            ))
+        else:
+            for _ in range(tail):
+                layers.append(make(filters, 1, False, dtype))
 
     layers += [nn.GlobalAvgPool2D(), nn.Dense(num_classes, dtype=dtype)]
     return nn.Sequential(layers, name=f"resnet{depth}")

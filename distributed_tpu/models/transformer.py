@@ -68,6 +68,7 @@ def transformer_lm(
     moe_experts: int = 0,
     moe_every: int = 2,
     pipeline: bool = False,
+    scan: bool = False,
     remat: bool = False,
     remat_policy=None,
     flash="auto",
@@ -82,6 +83,10 @@ def transformer_lm(
     pipeline over the 'pipe' mesh axis under ``DataPipelineParallel`` (and
     run as a weight-stacked scan otherwise); incompatible with MoE blocks
     (aux-loss state can't ride the microbatch schedule).
+    ``scan=True`` stacks them in an ``nn.ScannedBlocks`` — one lax.scan over
+    weight-stacked blocks, keeping static op count and compile time
+    depth-independent (generation requires the unrolled form; scanned
+    stacks refuse incremental decode).
     ``remat=True`` wraps every attention/FFN residual in ``nn.Remat`` —
     backward recomputes block activations instead of holding them in HBM
     (identical numerics and checkpoint paths, O(1)-blocks activation
@@ -94,9 +99,13 @@ def transformer_lm(
         nn.Embedding(vocab_size, d_model, dtype=dtype),
         nn.PositionalEmbedding(max_len),
     ]
-    if pipeline:
+    if pipeline or scan:
         if moe_experts:
-            raise ValueError("pipeline=True does not support MoE blocks")
+            raise ValueError(
+                "pipeline/scan block stacking does not support MoE blocks"
+            )
+        if pipeline and scan:
+            raise ValueError("pipeline and scan are mutually exclusive")
 
         def make_block():
             block = nn.Sequential(
@@ -107,7 +116,8 @@ def transformer_lm(
             )
             return nn.Remat(block, policy=remat_policy) if remat else block
 
-        layers.append(nn.PipelinedBlocks(make_block, num_layers))
+        stack = nn.PipelinedBlocks if pipeline else nn.ScannedBlocks
+        layers.append(stack(make_block, num_layers))
     else:
         for i in range(num_layers):
             moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0

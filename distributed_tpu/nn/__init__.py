@@ -1,6 +1,7 @@
 from .attention import MultiHeadAttention, PositionalEmbedding
 from .moe import MoE
 from .pipeline import PipelinedBlocks
+from .scan import ScannedBlocks
 from .remat import Remat
 from .core import Lambda, Layer, Residual, Sequential
 from .layers import (
@@ -15,6 +16,7 @@ from .layers import (
     GlobalAvgPool2D,
     LayerNorm,
     MaxPool2D,
+    SpaceToDepth,
 )
 
 __all__ = [
@@ -33,9 +35,11 @@ __all__ = [
     "LayerNorm",
     "Dropout",
     "Embedding",
+    "SpaceToDepth",
     "MultiHeadAttention",
     "MoE",
     "PipelinedBlocks",
+    "ScannedBlocks",
     "PositionalEmbedding",
     "Remat",
 ]

@@ -157,6 +157,38 @@ class Dense(Layer):
         return self.activation(y), {}
 
 
+class SpaceToDepth(Layer):
+    """Rearrange (B, H, W, C) -> (B, H/b, W/b, C*b*b) spatial blocks.
+
+    The TPU stem trick: a 7x7/2 conv on 3-channel input packs only 3 of the
+    MXU's 128 input lanes; space-to-depth by 2 turns the same arithmetic
+    into a 4x4/1 conv on 12 channels (4x the lane packing), which XLA tiles
+    far better. Pure data movement — one fused reshape/transpose pass."""
+
+    decode_safe = False  # mixes spatial positions
+
+    def __init__(self, block_size: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.block_size = int(block_size)
+
+    def init(self, key, input_shape: Shape):
+        h, w, c = input_shape
+        b = self.block_size
+        if h % b or w % b:
+            raise ValueError(
+                f"SpaceToDepth({b}) needs spatial dims divisible by {b}; "
+                f"got {(h, w)}"
+            )
+        return {}, {}, (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        x = x.reshape(n, h // b, b, w // b, b, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h // b, w // b, c * b * b), {}
+
+
 class Flatten(Layer):
     decode_safe = False  # collapses all non-batch axes, including time
 
@@ -323,10 +355,21 @@ class BatchNorm(Layer):
     sync-BN by construction, no separate "SyncBatchNorm" needed.
     """
 
-    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5, name=None):
+    # Class-level default for the batch-stats reduction strategy:
+    # "reduce" (jnp.mean) or "dot" (matmul against ones — see apply()).
+    stats_impl = "reduce"
+
+    def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
+                 stats_impl: Optional[str] = None, name=None):
         super().__init__(name)
         self.momentum = float(momentum)
         self.epsilon = float(epsilon)
+        if stats_impl is not None:
+            if stats_impl not in ("reduce", "dot"):
+                raise ValueError(
+                    f"stats_impl must be 'reduce' or 'dot', got {stats_impl!r}"
+                )
+            self.stats_impl = stats_impl
 
     def init(self, key, input_shape: Shape):
         c = input_shape[-1]
@@ -337,22 +380,51 @@ class BatchNorm(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         reduce_axes = tuple(range(x.ndim - 1))
         if train:
-            # Batch-mean-centered two-pass statistics with f32-accumulating
-            # reductions directly on the (possibly bf16) input: well-
-            # conditioned for any activation scale (unlike E[x^2]-mu^2,
-            # which cancels catastrophically when |mean| >> std), and the
-            # activation is read in its storage dtype. _bn_norm's custom
-            # VJP returns zero cotangents for the stats, so autodiff keeps
-            # no residual of these reductions (no f32 activation copy).
-            mean = lax.stop_gradient(
-                jnp.mean(x, axis=reduce_axes, dtype=jnp.float32)
-            )
-            var = lax.stop_gradient(
+            # Single-pass shifted-moment statistics: reduce (x - shift) and
+            # (x - shift)^2 in one fused read of the full activation, where
+            # shift is a per-channel estimate of the batch mean taken from
+            # the FIRST batch element only (~H*W samples per channel — mean
+            # error O(std/sqrt(HW)), a cheap serialized pre-reduce over 1/B
+            # of the data). Both full reductions are then siblings over the
+            # same fusion producer, so XLA emits ONE pass over HBM (the
+            # naive two-pass form serializes mean -> var and reads the
+            # activation twice; measured ~13ms/step extra on ResNet-50 @
+            # 256). Shifting keeps E[xc^2] - E[xc]^2 well-conditioned (xc
+            # is near zero-mean even when |mean| >> std, where the raw
+            # E[x^2] - mu^2 form cancels catastrophically — and unlike a
+            # running-mean shift, a data-derived shift is valid on the very
+            # first step, when the running mean is still 0).
+            # _bn_norm's custom VJP returns zero cotangents for the stats,
+            # so autodiff keeps no residual of these reductions.
+            shift = lax.stop_gradient(
                 jnp.mean(
-                    jnp.square(x.astype(jnp.float32) - mean),
-                    axis=reduce_axes, dtype=jnp.float32,
+                    x[:1].astype(jnp.float32),
+                    axis=tuple(range(x.ndim - 1)),
                 )
             )
+            if self.stats_impl == "dot":
+                # Reduce via a dot against ones: XLA's reduce of a large
+                # NHWC activation runs well below HBM bandwidth on some
+                # TPU runtimes, while a (1, N) x (N, C) matmul streams the
+                # operand at full speed through the MXU.
+                n = x.size // x.shape[-1]
+                x2 = x.reshape(n, x.shape[-1])
+                ones = jnp.ones((1, n), x.dtype)
+                xc = x2.astype(jnp.float32) - shift
+                m1 = lax.stop_gradient(
+                    jnp.dot(ones.astype(jnp.float32), xc)[0] / n
+                )
+                m2 = lax.stop_gradient(
+                    jnp.dot(ones.astype(jnp.float32), jnp.square(xc))[0] / n
+                )
+            else:
+                xc = x.astype(jnp.float32) - shift
+                m1 = lax.stop_gradient(jnp.mean(xc, axis=reduce_axes))
+                m2 = lax.stop_gradient(
+                    jnp.mean(jnp.square(xc), axis=reduce_axes)
+                )
+            mean = shift + m1
+            var = jnp.maximum(m2 - jnp.square(m1), 0.0)
             m = self.momentum
             new_state = {
                 "mean": m * state["mean"] + (1 - m) * mean,

@@ -99,27 +99,12 @@ class PipelinedBlocks(Layer):
         return getattr(self.block, "needs_rng", False)
 
     def init(self, key, input_shape: Shape):
+        from .scan import init_stacked_blocks
+
         shape = tuple(input_shape)
-        keys = jax.random.split(key, self.num_blocks)
-        per_stage = []
-        for i in range(self.num_blocks):
-            # Fresh instance per stage: layer naming is stateful per
-            # container, and the template must not accumulate names.
-            block = self.block if i == 0 else self.block_fn()
-            p, s, out = block.init(keys[i], shape)
-            if s:
-                raise ValueError(
-                    "PipelinedBlocks requires stateless blocks (got state "
-                    f"keys {list(s)}); running stats can't ride a "
-                    "microbatch schedule"
-                )
-            if tuple(out) != shape:
-                raise ValueError(
-                    f"Pipeline blocks must preserve shape: {shape} -> {out}"
-                )
-            per_stage.append(p)
-        params = jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack(leaves), *per_stage
+        params, _ = init_stacked_blocks(
+            self.block_fn, self.block, self.num_blocks, key, shape,
+            require_stateless=True, container="PipelinedBlocks",
         )
         return {"blocks": params}, {}, shape
 
@@ -137,25 +122,14 @@ class PipelinedBlocks(Layer):
     def _scan_blocks(self, stacked, x, *, train, rngs):
         """Run a stack of block params over x: scan over the stage dim.
         Shared by the sequential path (whole stack) and each pipeline rank's
-        stage (its local slice). Block outputs cast back to the input dtype
-        (the scan carry must be dtype-stable; a bf16-compute block in an f32
-        activation stream behaves like any mixed-precision layer)."""
-        block = self.block
+        stage (its local slice); the scan body itself lives in
+        scan.scan_stacked so ScannedBlocks and this layer can't diverge."""
+        from .scan import scan_stacked
 
-        if rngs is None:
-            def body(h, p):
-                y, _ = block.apply(p, {}, h, train=train)
-                return y.astype(h.dtype), None
-
-            x, _ = lax.scan(body, x, stacked)
-        else:
-            def body(h, pr):
-                p, r = pr
-                y, _ = block.apply(p, {}, h, train=train, rng=r)
-                return y.astype(h.dtype), None
-
-            x, _ = lax.scan(body, x, (stacked, rngs))
-        return x
+        # Blocks are validated stateless at init: the state stack is empty.
+        out, _ = scan_stacked(self.block, stacked, {}, x,
+                              train=train, rngs=rngs)
+        return out
 
     def apply(self, params, state, x, *, train=False, rng=None):
         from ..parallel.strategy import current_strategy

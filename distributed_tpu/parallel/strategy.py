@@ -360,6 +360,10 @@ class DataTensorParallel(_HintedParallel):
             return PartitionSpec(*([None] * (ndim - 1) + [m]))
         if role == "row":  # shard input dim (first)
             return PartitionSpec(*([m] + [None] * (ndim - 1)))
+        if role == "row1" and ndim >= 2:
+            # 'row' behind a stacked leading dim (ScannedBlocks): dim 0 is
+            # the block-stack index, the sharded input dim is dim 1.
+            return PartitionSpec(*([None, m] + [None] * (ndim - 2)))
         return PartitionSpec()
 
 
@@ -680,6 +684,9 @@ class CompositeParallel(_HintedParallel):
         spec = [None] * len(shape)
         if role in ("col", "row") and self.model_axis:
             spec[-1 if role == "col" else 0] = self.model_axis
+        elif role == "row1" and self.model_axis and len(shape) >= 2:
+            # 'row' behind a stacked leading dim (ScannedBlocks).
+            spec[1] = self.model_axis
         elif role == "expert" and self.expert_axis:
             spec[0] = self.expert_axis
         elif role == "pipe" and self.pipe_axis:

@@ -328,6 +328,19 @@ class Model:
             if steps_per_epoch is None:
                 steps_per_epoch = n // batch_size
         self.strategy.local_batch_size(batch_size)  # divisibility check
+        if (
+            validation_data is not None
+            and hasattr(validation_data, "__next__")
+            and validation_steps is None
+            and getattr(validation_data, "steps_per_pass", None) is None
+        ):
+            # Fail now, not after the first epoch's work is spent: the
+            # epoch-end validation hook would raise exactly this.
+            raise ValueError(
+                "validation_steps is required when validation_data is a "
+                "plain iterator (sources with steps_per_pass, e.g. "
+                "data.Pipeline, default to one pass)"
+            )
         step_fn = self._get_train_step()
         history = History()
         is_chief = jax.process_index() == 0

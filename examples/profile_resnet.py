@@ -1,0 +1,171 @@
+"""Bisect ResNet-50 step time on the real chip to find the MFU bottleneck.
+
+Times (a) the full train step, (b) forward only, (c) forward+backward without
+the optimizer, (d) a BN-free variant, (e) the stem alone, (f) per-stage
+truncated models. Prints one line per measurement with achieved TFLOP/s where
+an analytic count exists.
+
+Usage: python examples/profile_resnet.py [batch] [image_size]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+def sync(v):
+    np.asarray(jax.device_get(v))
+
+
+def timeit(fn, *args, warmup=3, measure=10):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    sync(jax.tree_util.tree_leaves(out)[-1])
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        out = fn(*args)
+    sync(jax.tree_util.tree_leaves(out)[-1])
+    return (time.perf_counter() - t0) / measure
+
+
+def time_train_step(step, model, x, y, key, warmup=3, measure=10):
+    """Like bench._time_steps: thread the donated params/state/opt through."""
+    p, s, o = model.params, model.state, model.opt_state
+    loss = None
+    for _ in range(warmup):
+        p, s, o, loss, _ = step(p, s, o, x, y, key)
+    sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        p, s, o, loss, _ = step(p, s, o, x, y, key)
+    sync(loss)
+    return (time.perf_counter() - t0) / measure
+
+
+def build(module, image_size, loss=True):
+    model = dtpu.Model(module)
+    model.compile(
+        optimizer=dtpu.optim.SGD(0.1, momentum=0.9),
+        loss="sparse_categorical_crossentropy",
+        metrics=["accuracy"],
+    )
+    model.build((image_size, image_size, 3))
+    return model
+
+
+def main(batch=256, image_size=224):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, image_size, image_size, 3),
+                                        dtype=np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, (batch,), dtype=np.int64).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    fwd_flop = 3.0 * 4.089e9 * (image_size / 224.0) ** 2 * batch  # train step
+
+    def report(label, secs, flops=None):
+        msg = f"{label:36s} {secs*1e3:8.2f} ms"
+        if flops:
+            msg += f"  {flops/secs/1e12:7.2f} TFLOP/s"
+        print(msg, flush=True)
+
+    # (a) full train step
+    model = build(dtpu.models.resnet(50, 1000, dtype=jnp.bfloat16), image_size)
+    step = model._get_train_step()
+    t = time_train_step(step, model, x, y, key)
+    report("full train step", t, fwd_flop)
+    # re-init: the timed step donated the original param buffers
+    model = build(dtpu.models.resnet(50, 1000, dtype=jnp.bfloat16), image_size)
+    p, s = model.params, model.state
+
+    # (b) forward only (train-mode apply, no grad)
+    module = model.module
+
+    @jax.jit
+    def fwd(p, s):
+        out, _ = module.apply(p, s, x.astype(jnp.bfloat16), train=True)
+        return out
+
+    t = timeit(lambda: fwd(p, s))
+    report("forward only (train mode)", t, fwd_flop / 3.0)
+
+    @jax.jit
+    def fwd_eval(p, s):
+        out, _ = module.apply(p, s, x.astype(jnp.bfloat16), train=False)
+        return out
+
+    t = timeit(lambda: fwd_eval(p, s))
+    report("forward only (eval mode)", t, fwd_flop / 3.0)
+
+    # (c) forward+backward, no optimizer/metrics
+    @jax.jit
+    def fwdbwd(p, s):
+        def loss_fn(p):
+            logits, s2 = module.apply(p, s, x.astype(jnp.bfloat16), train=True)
+            onehot = jax.nn.one_hot(y, 1000, dtype=logits.dtype)
+            return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+        l, g = jax.value_and_grad(loss_fn)(p)
+        # return the grads too — returning only the loss lets XLA dead-code
+        # eliminate the entire backward pass
+        return l, g
+
+    t = timeit(lambda: fwdbwd(p, s)[0])
+    report("fwd+bwd (no opt/metrics)", t, fwd_flop)
+
+    # (d) BN-free resnet (identity in place of BatchNorm)
+    import importlib
+    R = importlib.import_module("distributed_tpu.models.resnet")
+    orig_bn = nn.BatchNorm
+    class NoBN(nn.Layer):
+        def init(self, key, shape):
+            return {}, {}, tuple(shape)
+        def apply(self, params, state, x, *, train=False, rng=None):
+            return x, {}
+    R.nn.BatchNorm = NoBN
+    try:
+        model_nobn = build(dtpu.models.resnet(50, 1000, dtype=jnp.bfloat16),
+                           image_size)
+    finally:
+        R.nn.BatchNorm = orig_bn
+    step_nb = model_nobn._get_train_step()
+    t = time_train_step(step_nb, model_nobn, x, y, key)
+    report("train step, BN removed", t, fwd_flop)
+
+    # (e) stem alone (conv7x7/2 + BN + relu + maxpool)
+    stem = nn.Sequential(
+        [nn.Conv2D(64, 7, strides=2, padding="same", use_bias=False,
+                   dtype=jnp.bfloat16),
+         nn.BatchNorm(), nn.Activation("relu"),
+         nn.MaxPool2D(3, strides=2, padding="same")],
+        name="stem")
+    ps, ss, _ = stem.init(key, (image_size, image_size, 3))
+
+    @jax.jit
+    def stem_fb(p, s):
+        def loss_fn(p):
+            out, _ = stem.apply(p, s, x.astype(jnp.bfloat16), train=True)
+            return jnp.sum(out.astype(jnp.float32))
+        return jax.value_and_grad(loss_fn)(p)[0]
+
+    stem_flop = 3.0 * 2 * 7 * 7 * 3 * 64 * (image_size // 2) ** 2 * batch
+    t = timeit(lambda: stem_fb(ps, ss))
+    report("stem fwd+bwd", t, stem_flop)
+
+    # (f) truncated: stem + stage1..k (bottleneck stages)
+    for k in (1, 2, 3, 4):
+        mod = dtpu.models.resnet(50, 1000, stage_blocks=(3, 4, 6, 3)[:k],
+                                 dtype=jnp.bfloat16)
+        m = build(mod, image_size)
+        t = time_train_step(m._get_train_step(), m, x, y, key)
+        report(f"train step, stages 1..{k}", t)
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:]]
+    main(*args)

@@ -129,3 +129,35 @@ def test_batchnorm_stats_match_f32_reference():
         np.testing.assert_allclose(got_mean, want_mean, rtol=2e-3, atol=2e-3)
         np.testing.assert_allclose(got_var, want_var, rtol=2e-2, atol=2e-2)
         assert (got_var >= 0).all()
+
+
+def test_space_to_depth_rearranges_blocks():
+    s2d = nn.SpaceToDepth(2)
+    _, _, out = s2d.init(jax.random.PRNGKey(0), (4, 6, 3))
+    assert out == (2, 3, 12)
+    x = jnp.arange(2 * 4 * 6 * 3, dtype=jnp.float32).reshape(2, 4, 6, 3)
+    y, _ = s2d.apply({}, {}, x)
+    assert y.shape == (2, 2, 3, 12)
+    # block (0,0) of image 0 = rows 0-1, cols 0-1, channel-major within block
+    want = np.concatenate(
+        [np.asarray(x)[0, 0, 0], np.asarray(x)[0, 0, 1],
+         np.asarray(x)[0, 1, 0], np.asarray(x)[0, 1, 1]])
+    np.testing.assert_array_equal(np.asarray(y)[0, 0, 0], want)
+    with pytest.raises(ValueError):
+        nn.SpaceToDepth(2).init(jax.random.PRNGKey(0), (5, 6, 3))
+
+
+def test_resnet_space_to_depth_stem_trains():
+    import distributed_tpu as dtpu
+
+    model = dtpu.Model(dtpu.models.resnet(
+        50, 10, stem="space_to_depth", stage_blocks=(1, 1, 1, 1)))
+    model.compile(optimizer=dtpu.optim.SGD(0.1),
+                  loss="sparse_categorical_crossentropy")
+    model.build((32, 32, 3))
+    x = np.random.default_rng(0).standard_normal((4, 32, 32, 3)).astype(np.float32)
+    y = np.array([0, 1, 2, 3], np.int32)
+    hist = model.fit(x, y, batch_size=4, epochs=1, steps_per_epoch=1, verbose=0)
+    assert np.isfinite(hist.history["loss"][0])
+    with pytest.raises(ValueError):
+        dtpu.models.resnet(50, 10, stem="nope")

@@ -1,0 +1,192 @@
+"""ScannedBlocks: weight-stacked lax.scan execution of identical blocks.
+
+Parity contract: identical numerics to applying the template block
+sequentially with each block's params/state slice (which is what the
+unrolled Sequential would compute with the same per-block parameters).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+def _block_fn():
+    return nn.Sequential(
+        [nn.Dense(8), nn.BatchNorm(), nn.Activation("relu")]
+    )
+
+
+def _unrolled_apply(block, stacked_p, stacked_s, x, *, train):
+    h = x
+    new_states = []
+    n = jax.tree_util.tree_leaves(stacked_p)[0].shape[0]
+    for i in range(n):
+        p_i = jax.tree_util.tree_map(lambda l: l[i], stacked_p)
+        s_i = jax.tree_util.tree_map(lambda l: l[i], stacked_s)
+        h, ns = block.apply(p_i, s_i, h, train=train)
+        new_states.append(ns)
+    return h, new_states
+
+
+def test_scanned_matches_unrolled_forward_and_state():
+    sb = nn.ScannedBlocks(_block_fn, 3)
+    params, state, out_shape = sb.init(jax.random.PRNGKey(0), (8,))
+    assert out_shape == (8,)
+    stacked = params["blocks"]
+    assert jax.tree_util.tree_leaves(stacked)[0].shape[0] == 3
+
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((16, 8)), jnp.float32
+    )
+    y, new_state = sb.apply(params, state, x, train=True)
+    y_ref, states_ref = _unrolled_apply(
+        sb.block, stacked, state["blocks"], x, train=True
+    )
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-5,
+                               atol=2e-5)
+    # Stacked new state slice i == unrolled block i's new state.
+    for i, ns_ref in enumerate(states_ref):
+        got_i = jax.tree_util.tree_map(lambda l: l[i], new_state["blocks"])
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5),
+            got_i, ns_ref,
+        )
+
+    # Eval mode returns no new state (mirrors Sequential's omit-when-empty).
+    _, es = sb.apply(params, state, x, train=False)
+    assert es == {}
+
+
+def test_scanned_matches_unrolled_gradients():
+    sb = nn.ScannedBlocks(_block_fn, 3)
+    params, state, _ = sb.init(jax.random.PRNGKey(1), (8,))
+    x = jnp.asarray(
+        np.random.default_rng(1).standard_normal((4, 8)), jnp.float32
+    )
+
+    def loss_scanned(p):
+        y, _ = sb.apply(p, state, x, train=True)
+        return jnp.sum(y**2)
+
+    def loss_unrolled(p):
+        y, _ = _unrolled_apply(sb.block, p["blocks"], state["blocks"], x,
+                               train=True)
+        return jnp.sum(y**2)
+
+    g1 = jax.grad(loss_scanned)(params)
+    g2 = jax.grad(loss_unrolled)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
+        g1, g2,
+    )
+
+
+def test_scanned_blocks_validations():
+    with pytest.raises(ValueError):
+        nn.ScannedBlocks(_block_fn, 0)
+    # Non-shape-preserving block
+    with pytest.raises(ValueError):
+        nn.ScannedBlocks(lambda: nn.Dense(4), 2).init(
+            jax.random.PRNGKey(0), (8,)
+        )
+    # No incremental decode through a scanned stack
+    sb = nn.ScannedBlocks(_block_fn, 2)
+    params, state, _ = sb.init(jax.random.PRNGKey(0), (8,))
+    with pytest.raises(NotImplementedError):
+        sb.decode(params, state, {}, jnp.zeros((1, 8)), pos=0)
+
+
+def test_resnet_scan_stages_trains_and_shrinks_tree():
+    kw = dict(stage_blocks=(3, 3, 3, 3), width=16, small_inputs=True)
+    unrolled = dtpu.models.resnet(50, 10, **kw)
+    scanned = dtpu.models.resnet(50, 10, scan_stages=True, **kw)
+    pu, _, _ = unrolled.init(jax.random.PRNGKey(0), (16, 16, 3))
+    ps, _, _ = scanned.init(jax.random.PRNGKey(0), (16, 16, 3))
+    n_u = len(jax.tree_util.tree_leaves(pu))
+    n_s = len(jax.tree_util.tree_leaves(ps))
+    assert n_s < n_u  # stacked tails collapse the leaf count
+    # Same total parameter count
+    size = lambda t: sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(t))
+    assert size(pu) == size(ps)
+
+    model = dtpu.Model(dtpu.models.resnet(50, 10, scan_stages=True, **kw))
+    model.compile(optimizer=dtpu.optim.SGD(0.1, momentum=0.9),
+                  loss="sparse_categorical_crossentropy")
+    model.build((16, 16, 3))
+    x = np.random.default_rng(0).standard_normal((8, 16, 16, 3)).astype(
+        np.float32)
+    y = np.arange(8, dtype=np.int32) % 10
+    hist = model.fit(x, y, batch_size=8, epochs=2, steps_per_epoch=1,
+                     verbose=0)
+    assert np.isfinite(hist.history["loss"]).all()
+
+
+def test_scanned_blocks_with_dropout_rng():
+    sb = nn.ScannedBlocks(
+        lambda: nn.Sequential([nn.Dense(8), nn.Dropout(0.5)]), 2)
+    params, state, _ = sb.init(jax.random.PRNGKey(0), (8,))
+    assert sb.needs_rng
+    x = jnp.ones((4, 8))
+    y1, _ = sb.apply(params, state, x, train=True,
+                     rng=jax.random.PRNGKey(1))
+    y2, _ = sb.apply(params, state, x, train=True,
+                     rng=jax.random.PRNGKey(2))
+    ye, _ = sb.apply(params, state, x, train=False)
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+    assert np.isfinite(np.asarray(ye)).all()
+
+
+def test_transformer_lm_scan_trains_and_refuses_generate():
+    m = dtpu.Model(dtpu.models.transformer_lm(
+        64, num_layers=3, d_model=32, num_heads=4, max_len=16, scan=True))
+    m.compile(optimizer=dtpu.optim.Adam(1e-3),
+              loss="sparse_categorical_crossentropy")
+    m.build((16,))
+    x = np.zeros((4, 16), np.int32)
+    h = m.fit(x, x, batch_size=4, epochs=1, steps_per_epoch=2, verbose=0)
+    assert np.isfinite(h.history["loss"]).all()
+    with pytest.raises(NotImplementedError):
+        m.generate(np.zeros((1, 4), np.int32), 4)
+    with pytest.raises(ValueError):
+        dtpu.models.transformer_lm(64, scan=True, pipeline=True)
+    with pytest.raises(ValueError):
+        dtpu.models.transformer_lm(64, scan=True, moe_experts=2)
+    with pytest.raises(ValueError):
+        dtpu.models.resnet(50, 10, small_inputs=True, stem="space_to_depth")
+
+
+def test_scanned_blocks_tensor_parallel_hints():
+    """Inner Megatron roles survive the stack: 'col' -> last dim, 'row' ->
+    dim 1 (behind the stack index) under DataTensorParallel."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    strategy = dtpu.DataTensorParallel(model_parallel=2)
+    with strategy.scope():
+        m = dtpu.Model(dtpu.models.transformer_lm(
+            64, num_layers=2, d_model=32, num_heads=4, max_len=16, scan=True))
+        m.compile(optimizer=dtpu.optim.Adam(1e-3),
+                  loss="sparse_categorical_crossentropy")
+        m.build((16,))
+    blocks = m.params["scanned_blocks"]["blocks"]
+    # FFN in-projection is 'col' (last dim over model axis)
+    ffn_in = blocks["residual_1"]["main"]["dense"]["kernel"]
+    assert ffn_in.sharding.spec == PartitionSpec(None, None, "model"), (
+        ffn_in.sharding)
+    # FFN out-projection is 'row' -> 'row1' (dim 1 over model axis)
+    ffn_out = blocks["residual_1"]["main"]["dense_1"]["kernel"]
+    assert ffn_out.sharding.spec == PartitionSpec(None, "model", None), (
+        ffn_out.sharding)
+    # And the stacked model still trains a step.
+    x = np.zeros((4, 16), np.int32)
+    h = m.fit(x, x, batch_size=4, epochs=1, steps_per_epoch=1, verbose=0)
+    assert np.isfinite(h.history["loss"]).all()
