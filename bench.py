@@ -196,6 +196,36 @@ def bench_convergence(batch=GLOBAL_BATCH, max_epochs=20, target=0.98,
     }
 
 
+# ------------------------------------------------------------------- cifar --
+def bench_cifar(global_batch=GLOBAL_BATCH, warmup=5, measure=50):
+    """CIFAR-10-scale CNN (BASELINE.json configs[2]): the VGG-ish
+    ``cifar_cnn`` at 32x32x3, data-parallel when >1 device."""
+    strategy = _strategy()
+    with strategy.scope():
+        model = dtpu.Model(dtpu.models.cifar_cnn())
+        model.compile(
+            optimizer=dtpu.optim.SGD(0.01, momentum=0.9),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+    model.build((32, 32, 3))
+
+    rng = np.random.default_rng(0)
+    batch = model.strategy.put_batch({
+        "x": rng.standard_normal((global_batch, 32, 32, 3),
+                                 dtype=np.float32),
+        "y": rng.integers(0, 10, (global_batch,), dtype=np.int64)
+            .astype(np.int32),
+    })
+    steps_per_sec = _time_steps(model, batch, warmup, measure)
+    return {
+        "metric": f"cifar_cnn_train_steps_per_sec_gb{global_batch}",
+        "value": round(steps_per_sec, 2),
+        "unit": "steps/s",
+        "images_per_sec": round(steps_per_sec * global_batch, 1),
+    }
+
+
 # ---------------------------------------------------------------- resnet50 --
 def bench_resnet50(global_batch=256, image_size=224, warmup=3, measure=20,
                    num_classes=1000, depth=50):
@@ -317,8 +347,8 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
     return out
 
 
-def main(modes=("mnist", "convergence", "resnet50", "lm")):
-    known = {"mnist", "convergence", "resnet50", "lm"}
+def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
+    known = {"mnist", "convergence", "cifar", "resnet50", "lm"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -328,6 +358,8 @@ def main(modes=("mnist", "convergence", "resnet50", "lm")):
     extra = []
     if "convergence" in modes:
         extra.append(bench_convergence())
+    if "cifar" in modes:
+        extra.append(bench_cifar())
     if "resnet50" in modes:
         extra.append(bench_resnet50())
     if "lm" in modes:
@@ -340,4 +372,5 @@ def main(modes=("mnist", "convergence", "resnet50", "lm")):
 
 
 if __name__ == "__main__":
-    main(tuple(sys.argv[1:]) or ("mnist", "convergence", "resnet50", "lm"))
+    main(tuple(sys.argv[1:])
+         or ("mnist", "convergence", "cifar", "resnet50", "lm"))

@@ -409,13 +409,11 @@ class BatchNorm(Layer):
                 # operand at full speed through the MXU.
                 n = x.size // x.shape[-1]
                 x2 = x.reshape(n, x.shape[-1])
-                ones = jnp.ones((1, n), x.dtype)
+                ones = jnp.ones((1, n), jnp.float32)
                 xc = x2.astype(jnp.float32) - shift
-                m1 = lax.stop_gradient(
-                    jnp.dot(ones.astype(jnp.float32), xc)[0] / n
-                )
+                m1 = lax.stop_gradient(jnp.dot(ones, xc)[0] / n)
                 m2 = lax.stop_gradient(
-                    jnp.dot(ones.astype(jnp.float32), jnp.square(xc))[0] / n
+                    jnp.dot(ones, jnp.square(xc))[0] / n
                 )
             else:
                 xc = x.astype(jnp.float32) - shift

@@ -24,14 +24,56 @@ def AdamW(learning_rate: float = 0.001, weight_decay: float = 0.01, b1=0.9, b2=0
     return optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
 
 
+def RMSprop(learning_rate: float = 0.001, decay: float = 0.9,
+            momentum: float = 0.0, eps: float = 1e-7):
+    return optax.rmsprop(learning_rate, decay=decay, momentum=momentum,
+                         eps=eps)
+
+
+def Adagrad(learning_rate: float = 0.001, eps: float = 1e-7):
+    return optax.adagrad(learning_rate, eps=eps)
+
+
+def Lamb(learning_rate: float = 0.001, weight_decay: float = 0.0,
+         b1: float = 0.9, b2: float = 0.999):
+    """Layer-wise adaptive large-batch optimizer — the standard choice for
+    the data-parallel global-batch scaling this framework's mesh enables."""
+    return optax.lamb(learning_rate, b1=b1, b2=b2,
+                      weight_decay=weight_decay)
+
+
 def sgd_with_cosine(learning_rate: float, steps: int, warmup: int = 0, momentum: float = 0.9):
-    sched = optax.warmup_cosine_decay_schedule(
+    return optax.sgd(cosine_schedule(learning_rate, steps, warmup),
+                     momentum=momentum)
+
+
+def cosine_schedule(learning_rate: float, steps: int, warmup: int = 0):
+    """Warmup-then-cosine decay schedule; pass as any optimizer's
+    learning_rate (optax schedules are plain callables)."""
+    return optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, max(warmup, 1), max(steps, warmup + 1)
     )
-    return optax.sgd(sched, momentum=momentum)
 
 
-_REGISTRY = {"sgd": SGD, "adam": Adam, "adamw": AdamW}
+def exponential_schedule(learning_rate: float, decay_rate: float,
+                         decay_steps: int, warmup: int = 0):
+    sched = optax.exponential_decay(
+        learning_rate, decay_steps, decay_rate
+    )
+    if warmup:
+        warm = optax.linear_schedule(0.0, learning_rate, warmup)
+        return optax.join_schedules([warm, sched], [warmup])
+    return sched
+
+
+_REGISTRY = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamW,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "lamb": Lamb,
+}
 
 
 def get(name_or_tx, **kwargs):

@@ -49,7 +49,7 @@ def time_train_step(step, model, x, y, key, warmup=3, measure=10):
     return (time.perf_counter() - t0) / measure
 
 
-def build(module, image_size, loss=True):
+def build(module, image_size):
     model = dtpu.Model(module)
     model.compile(
         optimizer=dtpu.optim.SGD(0.1, momentum=0.9),

@@ -69,6 +69,7 @@ def test_bench_output_contract(monkeypatch, capsys):
                       "vs_baseline": 2.0},
     )
     monkeypatch.setattr(bench, "bench_convergence", lambda **kw: {"metric": "c"})
+    monkeypatch.setattr(bench, "bench_cifar", lambda **kw: {"metric": "f"})
     monkeypatch.setattr(bench, "bench_resnet50", lambda **kw: {"metric": "r"})
     monkeypatch.setattr(bench, "bench_transformer_lm",
                         lambda **kw: {"metric": "t"})
@@ -77,5 +78,12 @@ def test_bench_output_contract(monkeypatch, capsys):
     assert len(lines) == 1
     rec = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
-    assert [e["metric"] for e in rec["extra"]] == ["c", "r", "t"]
+    assert [e["metric"] for e in rec["extra"]] == ["c", "f", "r", "t"]
     assert "device" in rec
+
+
+def test_bench_cifar_smoke():
+    out = bench.bench_cifar(global_batch=16, warmup=1, measure=2)
+    assert out["value"] > 0
+    assert out["images_per_sec"] > 0
+    assert "cifar_cnn" in out["metric"]

@@ -169,3 +169,79 @@ def test_explicit_coordinator_gathers_real_worker_list(tmp_path):
         assert host0 not in ("", "?")
     # identical list on both ranks (collective gather)
     assert results[0].value["workers"] == results[1].value["workers"]
+
+
+@pytest.mark.slow
+def test_spark_barrier_flow_end_to_end(tmp_path):
+    """The reference's full Spark-barrier workflow without Spark
+    (/root/reference/README.md:170-247): gang-scheduled workers receive a
+    barrier-style peer list + own rank, build their cluster spec with
+    from_barrier (strip the scheduler's ports, re-port 8000+seq,
+    README.md:180-183), train data-parallel, and return max accuracy AS A
+    STRING per worker (README.md:220) — except rank 0, which returns the
+    base64-encoded HDF5 model (README.md:236-247). The driver collects one
+    row per worker, checks the replica-identical-accuracy invariant
+    (README.md:226-232), and decodes rank 0's row into a model file."""
+    script = write_worker(
+        tmp_path,
+        """
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import distributed_tpu as dtpu
+        from distributed_tpu.cluster import from_barrier, from_env
+        from distributed_tpu.launch import report_result
+
+        # The gang launcher plays Spark's barrier: its injected spec is the
+        # stand-in for barrier$address / barrier$partition. Re-derive a
+        # Spark-shaped peer list (scheduler-owned ports) and rebuild the
+        # spec the way the reference's closure does.
+        injected = from_env()
+        barrier_addresses = [
+            f"{w.rsplit(':', 1)[0]}:{7077 + i}"
+            for i, w in enumerate(injected.workers)
+        ]
+        spec = from_barrier(barrier_addresses, injected.index,
+                            base_port=23840)
+        os.environ["DTPU_CONFIG"] = spec.to_json()
+        spec = dtpu.cluster.initialize()
+
+        x, y = dtpu.data.synthetic_images(256, (28, 28), 10, 0)
+        x = x[..., None].astype(np.float32) / 255.0
+        strategy = dtpu.DataParallel()
+        with strategy.scope():
+            m = dtpu.Model(dtpu.models.mnist_cnn())
+            m.compile(optimizer=dtpu.optim.SGD(0.05), metrics=["accuracy"])
+        hist = m.fit(x, y.astype(np.int32), batch_size=64, epochs=2,
+                     steps_per_epoch=3, verbose=0, seed=0)
+        acc = str(max(hist.metrics["accuracy"]))
+        if spec.index == 0:
+            import tempfile
+            path = os.path.join(tempfile.mkdtemp(), "trained-0.hdf5")
+            dtpu.checkpoint.export_hdf5(path, m.params)
+            report_result({"row": dtpu.checkpoint.artifact_encode(path),
+                           "acc": acc})
+        else:
+            report_result({"row": acc, "acc": acc})
+        """,
+    )
+    results = LocalLauncher().run([sys.executable, script], 2, timeout=300)
+    assert all(r.ok for r in results), [
+        (r.index, r.error, r.log_tail[-500:]) for r in results
+    ]
+    by_rank = {r.index: r for r in results}
+    assert len(by_rank) == 2  # one row per worker, like collect()
+    # Replica-identity invariant: identical accuracy strings on all workers.
+    accs = {r.value["acc"] for r in results}
+    assert len(accs) == 1, accs
+    # Rank 0's row is the artifact; decode it like the reference's driver.
+    from distributed_tpu.checkpoint import artifact_decode, import_hdf5
+
+    out = tmp_path / "model.hdf5"
+    artifact_decode(by_rank[0].value["row"], str(out))
+    params, _ = import_hdf5(str(out))
+    assert "conv2d" in params and "dense" in params
+    # Rank 1's row is a parseable accuracy in [0, 1] (README.md:226-232).
+    assert 0.0 <= float(by_rank[1].value["row"]) <= 1.0

@@ -51,3 +51,29 @@ def test_cross_entropy_with_ignore():
     loss = losses.cross_entropy_with_ignore(logits, labels)
     want = losses.sparse_categorical_crossentropy(logits[0:1, 0], jnp.array([3]))
     assert jnp.allclose(loss, want, rtol=1e-5)
+
+
+def test_optimizer_registry_zoo():
+    import pytest
+    """Every registered optimizer trains a step; schedules are callables."""
+    import distributed_tpu as dtpu
+    from distributed_tpu import optim
+
+    x = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+    y = (np.arange(8) % 2).astype(np.int32)
+    for name in ("sgd", "adam", "adamw", "rmsprop", "adagrad", "lamb"):
+        m = dtpu.Model(dtpu.nn.Sequential([dtpu.nn.Dense(2)]))
+        m.compile(optimizer=name, loss="sparse_categorical_crossentropy")
+        h = m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1, verbose=0)
+        assert np.isfinite(h.history["loss"][0]), name
+    with pytest.raises(ValueError):
+        optim.get("nope")
+    sched = optim.cosine_schedule(0.1, steps=100, warmup=10)
+    assert callable(sched) and float(sched(0)) <= 0.1
+    exp = optim.exponential_schedule(0.1, 0.9, 10, warmup=5)
+    assert callable(exp)
+    m = dtpu.Model(dtpu.nn.Sequential([dtpu.nn.Dense(2)]))
+    m.compile(optimizer=optim.SGD(optim.cosine_schedule(0.1, 100)),
+              loss="sparse_categorical_crossentropy")
+    h = m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1, verbose=0)
+    assert np.isfinite(h.history["loss"][0])
