@@ -85,8 +85,8 @@ def transformer_lm(
     (aux-loss state can't ride the microbatch schedule).
     ``scan=True`` stacks them in an ``nn.ScannedBlocks`` — one lax.scan over
     weight-stacked blocks, keeping static op count and compile time
-    depth-independent (generation requires the unrolled form; scanned
-    stacks refuse incremental decode).
+    depth-independent; generation works through stacked KV caches
+    (ScannedBlocks.decode scans the cached one-token step over the stack).
     ``remat=True`` wraps every attention/FFN residual in ``nn.Remat`` —
     backward recomputes block activations instead of holding them in HBM
     (identical numerics and checkpoint paths, O(1)-blocks activation

@@ -106,9 +106,12 @@ class ScannedBlocks(Layer):
     tests/test_scanned_blocks.py).
     """
 
-    # The scan stack has no per-block cache threading; autoregressive
-    # generation through it must fail loudly (same contract as
-    # PipelinedBlocks), not silently drop attention history.
+    # Incremental decode IS supported (unlike PipelinedBlocks): the KV
+    # caches are stacked with a leading (S, ...) block dim like the params,
+    # and decode() scans the template block's cached one-token step over
+    # them. decode_safe stays False so a template whose own decode would
+    # silently be wrong (position-mixing layers without a cached override)
+    # still fails loudly inside the scan body.
     decode_safe = False
 
     def __init__(
@@ -175,3 +178,39 @@ class ScannedBlocks(Layer):
         if jax.tree_util.tree_leaves(new_s):
             return out, {"blocks": new_s}
         return out, {}
+
+    # ---------------------------------------------------- incremental decode
+    def init_cache(self, params, batch, max_len, dtype):
+        # Cache shapes depend only on one block's param shapes; build the
+        # template's cache once and allocate an (S, ...)-stacked zero tree.
+        p0 = jax.tree_util.tree_map(lambda l: l[0], params["blocks"])
+        c0 = self.block.init_cache(p0, batch, max_len, dtype)
+        if not jax.tree_util.tree_leaves(c0):
+            return {}
+        return {
+            "blocks": jax.tree_util.tree_map(
+                lambda l: jnp.zeros((self.num_blocks,) + l.shape, l.dtype),
+                c0,
+            )
+        }
+
+    def decode(self, params, state, cache, x, *, pos):
+        """One-token step through the whole stack: scan the template's
+        cached decode over the stacked (params, state, cache), writing each
+        block's new KV rows back into its slice of the stacked cache."""
+        block = self.block
+
+        def body(h, per_block):
+            p, s, c = per_block
+            y, new_c = block.decode(p, s, c, h, pos=pos)
+            return y.astype(h.dtype), new_c
+
+        out, new_cache = lax.scan(
+            body,
+            x,
+            (params["blocks"], state.get("blocks", {}),
+             cache.get("blocks", {})),
+        )
+        if jax.tree_util.tree_leaves(new_cache):
+            return out, {"blocks": new_cache}
+        return out, cache

@@ -57,13 +57,11 @@ def cosine_schedule(learning_rate: float, steps: int, warmup: int = 0):
 
 def exponential_schedule(learning_rate: float, decay_rate: float,
                          decay_steps: int, warmup: int = 0):
-    sched = optax.exponential_decay(
-        learning_rate, decay_steps, decay_rate
-    )
     if warmup:
-        warm = optax.linear_schedule(0.0, learning_rate, warmup)
-        return optax.join_schedules([warm, sched], [warmup])
-    return sched
+        return optax.warmup_exponential_decay_schedule(
+            0.0, learning_rate, warmup, decay_steps, decay_rate
+        )
+    return optax.exponential_decay(learning_rate, decay_steps, decay_rate)
 
 
 _REGISTRY = {

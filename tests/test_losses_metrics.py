@@ -54,8 +54,8 @@ def test_cross_entropy_with_ignore():
 
 
 def test_optimizer_registry_zoo():
-    import pytest
     """Every registered optimizer trains a step; schedules are callables."""
+    import pytest
     import distributed_tpu as dtpu
     from distributed_tpu import optim
 
