@@ -43,6 +43,7 @@ def vit(
     num_heads: int = 12,
     d_ff: Optional[int] = None,
     remat: bool = False,
+    scan: bool = False,
     dtype=None,
 ) -> nn.Sequential:
     """(B, H, W, C) images -> (B, num_classes) logits."""
@@ -67,13 +68,25 @@ def vit(
         ),
         nn.PositionalEmbedding(n_tokens),
     ]
-    for _ in range(num_layers):
-        block = transformer_block(
-            d_model, num_heads, d_ff, causal=False, dtype=dtype
-        )
-        if remat:
-            block = [nn.Remat(residual) for residual in block]
-        layers += block
+    if scan:
+        # Weight-stacked encoder: one lax.scan over the blocks keeps static
+        # op count and compile time depth-independent (see nn.ScannedBlocks)
+        # — ViT has no autoregressive decode, so nothing is given up.
+        def make_block():
+            block = nn.Sequential(transformer_block(
+                d_model, num_heads, d_ff, causal=False, dtype=dtype
+            ))
+            return nn.Remat(block) if remat else block
+
+        layers.append(nn.ScannedBlocks(make_block, num_layers))
+    else:
+        for _ in range(num_layers):
+            block = transformer_block(
+                d_model, num_heads, d_ff, causal=False, dtype=dtype
+            )
+            if remat:
+                block = [nn.Remat(residual) for residual in block]
+            layers += block
     layers += [
         nn.LayerNorm(),
         nn.Lambda(

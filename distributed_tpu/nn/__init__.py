@@ -1,4 +1,5 @@
 from .attention import MultiHeadAttention, PositionalEmbedding
+from .augment import RandomCrop, RandomFlip
 from .moe import MoE
 from .pipeline import PipelinedBlocks
 from .scan import ScannedBlocks
@@ -36,6 +37,8 @@ __all__ = [
     "Dropout",
     "Embedding",
     "SpaceToDepth",
+    "RandomFlip",
+    "RandomCrop",
     "MultiHeadAttention",
     "MoE",
     "PipelinedBlocks",

@@ -1,0 +1,130 @@
+"""Device-side image augmentation layers (train-only, eval = identity).
+
+The reference's pipelines feed raw /255-scaled arrays with no augmentation
+(/root/reference/README.md:51-56); an ImageNet-scale flow (BASELINE.json
+configs[3]) needs the standard random-crop + horizontal-flip recipe. The
+TPU-first place for it is INSIDE the jitted train step, as layers: the
+flips/crops are elementwise/gather work XLA fuses with the input cast, the
+per-sample randomness comes from the step rng (so augmentation is
+deterministic given (seed, step) — crash-restart resume replays the same
+batches AND the same crops), and the host input pipeline stays a dumb
+byte-mover. This mirrors Keras's preprocessing layers
+(``tf.keras.layers.RandomFlip`` / ``RandomCrop``), so the migration story
+stays "same model code, TPU underneath".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .core import Layer, Shape
+
+
+class RandomFlip(Layer):
+    """Per-sample horizontal (and/or vertical) flip with probability 0.5.
+
+    Train-only; eval mode is the identity. Expects NHWC inputs.
+    """
+
+    needs_rng = True
+    decode_safe = False  # mixes spatial positions
+
+    def __init__(self, mode: str = "horizontal", name: Optional[str] = None):
+        super().__init__(name)
+        if mode not in ("horizontal", "vertical", "horizontal_and_vertical"):
+            raise ValueError(
+                f"mode must be 'horizontal', 'vertical', or "
+                f"'horizontal_and_vertical', got {mode!r}"
+            )
+        self.mode = mode
+
+    def init(self, key, input_shape: Shape):
+        if len(input_shape) != 3:
+            raise ValueError(
+                f"RandomFlip expects (H, W, C) inputs, got {input_shape}"
+            )
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        if not train:
+            return x, {}
+        if rng is None:
+            raise ValueError("RandomFlip needs an rng when train=True")
+        b = x.shape[0]
+        k_h, k_v = jax.random.split(rng)
+        if self.mode in ("horizontal", "horizontal_and_vertical"):
+            coin = jax.random.bernoulli(k_h, 0.5, (b, 1, 1, 1))
+            x = jnp.where(coin, x[:, :, ::-1, :], x)
+        if self.mode in ("vertical", "horizontal_and_vertical"):
+            coin = jax.random.bernoulli(k_v, 0.5, (b, 1, 1, 1))
+            x = jnp.where(coin, x[:, ::-1, :, :], x)
+        return x, {}
+
+
+class RandomCrop(Layer):
+    """Per-sample random crop to (height, width), optionally zero-padding
+    first (the CIFAR pad-4-crop-32 recipe). Eval mode center-crops.
+
+    Expects NHWC inputs; output is (height, width, C).
+    """
+
+    needs_rng = True
+    decode_safe = False  # mixes spatial positions
+
+    def __init__(self, height: int, width: int, *, padding: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.height = int(height)
+        self.width = int(width)
+        self.padding = int(padding)
+        if self.height < 1 or self.width < 1 or self.padding < 0:
+            raise ValueError(
+                f"Invalid crop ({height}x{width}, padding={padding})"
+            )
+
+    def init(self, key, input_shape: Shape):
+        if len(input_shape) != 3:
+            raise ValueError(
+                f"RandomCrop expects (H, W, C) inputs, got {input_shape}"
+            )
+        h, w, c = input_shape
+        p = self.padding
+        if self.height > h + 2 * p or self.width > w + 2 * p:
+            raise ValueError(
+                f"Crop {self.height}x{self.width} larger than padded input "
+                f"{h + 2 * p}x{w + 2 * p}"
+            )
+        return {}, {}, (self.height, self.width, c)
+
+    def _pad(self, x):
+        p = self.padding
+        if p == 0:
+            return x
+        return jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        xp = self._pad(x)
+        _, h, w, _ = xp.shape
+        max_y = h - self.height
+        max_x = w - self.width
+        if not train:
+            # Deterministic center crop.
+            y0, x0 = max_y // 2, max_x // 2
+            return xp[:, y0:y0 + self.height, x0:x0 + self.width, :], {}
+        if rng is None:
+            raise ValueError("RandomCrop needs an rng when train=True")
+        b = xp.shape[0]
+        k_y, k_x = jax.random.split(rng)
+        ys = jax.random.randint(k_y, (b,), 0, max_y + 1)
+        xs = jax.random.randint(k_x, (b,), 0, max_x + 1)
+
+        def crop_one(img, y0, x0):
+            return jax.lax.dynamic_slice(
+                img, (y0, x0, 0),
+                (self.height, self.width, img.shape[-1]),
+            )
+
+        return jax.vmap(crop_one)(xp, ys, xs), {}

@@ -187,9 +187,14 @@ class ScannedBlocks(Layer):
         c0 = self.block.init_cache(p0, batch, max_len, dtype)
         if not jax.tree_util.tree_leaves(c0):
             return {}
+        # Broadcast the template cache rather than allocating zeros: a
+        # layer whose cache initializes non-zero must start every block's
+        # slice from those values, exactly as the unrolled form would.
         return {
             "blocks": jax.tree_util.tree_map(
-                lambda l: jnp.zeros((self.num_blocks,) + l.shape, l.dtype),
+                lambda l: jnp.broadcast_to(
+                    l, (self.num_blocks,) + l.shape
+                ).copy(),
                 c0,
             )
         }

@@ -1,0 +1,94 @@
+"""Device-side augmentation layers: train-only randomness, eval identity,
+determinism under a fixed rng (the crash-restart resume contract extends to
+augmentation because it draws from the step rng)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu import nn
+
+
+def _imgs(b=8, h=8, w=8, c=3, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal((b, h, w, c)),
+        jnp.float32,
+    )
+
+
+def test_random_flip_eval_identity_and_train_flips():
+    layer = nn.RandomFlip("horizontal")
+    _, _, out = layer.init(jax.random.PRNGKey(0), (8, 8, 3))
+    assert out == (8, 8, 3)
+    x = _imgs()
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y, _ = layer.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(1))
+    # Every row is either the original or its horizontal mirror.
+    xn, yn = np.asarray(x), np.asarray(y)
+    flipped = xn[:, :, ::-1, :]
+    per_row_ok = [
+        np.array_equal(yn[i], xn[i]) or np.array_equal(yn[i], flipped[i])
+        for i in range(xn.shape[0])
+    ]
+    assert all(per_row_ok)
+    # With 8 rows the chance all stay unflipped under a working coin is 1/256;
+    # this seed flips at least one.
+    assert not np.array_equal(yn, xn)
+    # Deterministic under the same rng.
+    y2, _ = layer.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+    with pytest.raises(ValueError):
+        nn.RandomFlip("diagonal")
+
+
+def test_random_crop_shapes_padding_and_determinism():
+    layer = nn.RandomCrop(8, 8, padding=2)
+    _, _, out = layer.init(jax.random.PRNGKey(0), (8, 8, 3))
+    assert out == (8, 8, 3)
+    x = _imgs()
+    y, _ = layer.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(3))
+    assert y.shape == x.shape
+    y2, _ = layer.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+    # Eval = center crop; with padding=2 and same target size that's the
+    # original image back.
+    ye, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(ye), np.asarray(x))
+
+    # Crop to smaller than input without padding.
+    small = nn.RandomCrop(4, 6)
+    _, _, out = small.init(jax.random.PRNGKey(0), (8, 8, 3))
+    assert out == (4, 6, 3)
+    ys, _ = small.apply({}, {}, x, train=True, rng=jax.random.PRNGKey(0))
+    assert ys.shape == (8, 4, 6, 3)
+
+    with pytest.raises(ValueError):
+        nn.RandomCrop(12, 12).init(jax.random.PRNGKey(0), (8, 8, 3))
+
+
+def test_augmented_model_trains_and_evaluates():
+    """The CIFAR recipe: pad-4 random crop + horizontal flip in front of the
+    CNN — one jitted step, augmentation from the step rng."""
+    model = dtpu.Model(nn.Sequential([
+        nn.RandomCrop(8, 8, padding=1),
+        nn.RandomFlip("horizontal"),
+        nn.Conv2D(8, 3, activation="relu"),
+        nn.GlobalAvgPool2D(),
+        nn.Dense(4),
+    ]))
+    model.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.build((8, 8, 3))
+    x = np.asarray(_imgs(16))
+    y = (np.arange(16) % 4).astype(np.int32)
+    h = model.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+    assert np.isfinite(h.history["loss"]).all()
+    ev = model.evaluate(x, y, batch_size=8, verbose=0)
+    assert np.isfinite(ev["loss"])
+    # Eval path is deterministic (identity augmentation): repeatable.
+    ev2 = model.evaluate(x, y, batch_size=8, verbose=0)
+    assert ev["loss"] == ev2["loss"]

@@ -198,3 +198,27 @@ class TestViT:
         y = np.zeros((8,), np.int32)
         hist = model.fit(x, y, batch_size=8, epochs=1, verbose=0)
         assert len(hist.history["loss"]) == 1
+
+
+def test_vit_scan_matches_unrolled_param_count_and_trains():
+    import distributed_tpu as dtpu
+
+    kw = dict(image_size=16, patch_size=4, num_layers=3, d_model=32,
+              num_heads=4)
+    pu, _, _ = dtpu.models.vit(10, **kw).init(jax.random.PRNGKey(0),
+                                              (16, 16, 3))
+    ps, _, _ = dtpu.models.vit(10, scan=True, **kw).init(
+        jax.random.PRNGKey(0), (16, 16, 3))
+    size = lambda t: sum(int(np.prod(l.shape))
+                         for l in jax.tree_util.tree_leaves(t))
+    assert size(pu) == size(ps)
+
+    m = dtpu.Model(dtpu.models.vit(10, scan=True, remat=True, **kw))
+    m.compile(optimizer=dtpu.optim.Adam(1e-3),
+              loss="sparse_categorical_crossentropy")
+    m.build((16, 16, 3))
+    x = np.random.default_rng(0).standard_normal((4, 16, 16, 3)).astype(
+        np.float32)
+    y = np.arange(4, dtype=np.int32) % 10
+    h = m.fit(x, y, batch_size=4, epochs=1, steps_per_epoch=2, verbose=0)
+    assert np.isfinite(h.history["loss"]).all()
