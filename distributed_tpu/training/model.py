@@ -686,6 +686,63 @@ class Model:
             [prompt[:, :1].astype(np.int32), toks[:, : max_len - 1]], axis=1
         )
 
+    # ---------------------------------------------------------------- weights
+    def save_weights(self, path):
+        """Keras-shaped convenience: export this model's parameters AND
+        state (BatchNorm running stats — Keras counts them as
+        non-trainable weights) to an HDF5 file (npz if ``path`` ends in
+        .npz). Chief-only write; see checkpoint.Checkpointer for
+        step-tagged training checkpoints and checkpoint.ShardedCheckpointer
+        for per-process sharded saves."""
+        from .. import checkpoint as ckpt
+
+        if not self.built:
+            raise RuntimeError("Model not built")
+        tree = {"params": self.params, "state": self.state}
+        path = str(path)
+        if path.endswith(".npz"):
+            return ckpt.save_npz(path, tree)
+        return ckpt.export_hdf5(path, tree)
+
+    def load_weights(self, path):
+        """Load weights saved by :meth:`save_weights` (HDF5 or npz) and
+        re-place them under this model's strategy/sharding. Also accepts a
+        bare params tree (the ``export_hdf5(path, model.params)``
+        interchange format); state is left untouched in that case."""
+        from .. import checkpoint as ckpt
+
+        if not self.built:
+            raise RuntimeError(
+                "Build the model first (model.build(input_shape)) so the "
+                "loaded weights can be placed under its strategy"
+            )
+        path = str(path)
+        if path.endswith(".npz"):
+            loaded = ckpt.load_npz(path)
+            tree = loaded[0] if isinstance(loaded, tuple) else loaded
+        else:
+            tree, _ = ckpt.import_hdf5(path)
+        if set(tree) == {"params", "state"}:
+            params, state = tree["params"], tree["state"]
+        else:  # bare params interchange
+            params, state = tree, None
+        ref = jax.tree_util.tree_structure(self.params)
+        got = jax.tree_util.tree_structure(params)
+        if ref != got:
+            raise ValueError(
+                f"Loaded weight tree does not match the model: {got} vs {ref}"
+            )
+        self.params = self.strategy.put_params(
+            params, self.module.sharding_hints()
+        )
+        if state is not None:
+            self.state = self.strategy.put_params(state)
+        # Placements changed: every cached compiled step is stale.
+        self._train_step = self._eval_step = self._predict_step = None
+        if self.compiled:
+            self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
+        return self
+
     # ---------------------------------------------------------------- summary
     def summary(self):
         if self.input_shape is None:

@@ -86,3 +86,61 @@ def test_hdf5_export_import_and_artifact(tmp_path):
     out = dtpu.checkpoint.artifact_decode(b64, tmp_path / "copy.h5")
     params2, _ = dtpu.import_hdf5(out)
     assert tree_equal(m.params, params2)
+
+
+def test_save_load_weights_convenience(tmp_path):
+    """Keras-shaped save_weights/load_weights round-trips params AND state
+    (BatchNorm running stats) via HDF5 and npz, re-placing arrays under
+    the model's strategy."""
+    import pytest
+
+    def build():
+        # A BatchNorm model: the stats must round-trip, not just params.
+        m = dtpu.Model(dtpu.models.resnet(
+            18, 10, small_inputs=True, stage_blocks=(1, 1, 1, 1), width=8))
+        m.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.build((28, 28, 1), seed=3)
+        return m
+
+    m = build()
+    x = np.random.default_rng(0).standard_normal((8, 28, 28, 1)).astype(
+        np.float32)
+    y = (np.arange(8) % 10).astype(np.int32)
+    m.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=2, verbose=0)
+    want = m.predict(x, batch_size=8)
+
+    for fname in ("w.h5", "w.npz"):
+        path = tmp_path / fname
+        m.save_weights(path)
+        fresh = build()
+        before = fresh.predict(x, batch_size=8)
+        assert not np.allclose(before, want)
+        fresh.load_weights(path)
+        np.testing.assert_allclose(fresh.predict(x, batch_size=8), want,
+                                   rtol=1e-5, atol=1e-5)
+        # Training continues after a load (opt state re-inited).
+        h = fresh.fit(x, y, batch_size=8, epochs=1, steps_per_epoch=1,
+                      verbose=0)
+        assert np.isfinite(h.history["loss"]).all()
+
+    # State (BN running stats) actually moved: fresh state differs from
+    # trained state before the load, matches after.
+    trained_mean = np.asarray(
+        jax.tree_util.tree_leaves(m.state)[0])
+    fresh2 = build()
+    fresh2.load_weights(tmp_path / "w.h5")
+    np.testing.assert_allclose(
+        np.asarray(jax.tree_util.tree_leaves(fresh2.state)[0]),
+        trained_mean, rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(RuntimeError):
+        dtpu.Model(dtpu.models.mnist_cnn()).load_weights(tmp_path / "w.h5")
+    # Tree mismatch fails loudly.
+    other = dtpu.Model(dtpu.models.cifar_cnn())
+    other.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy")
+    other.build((32, 32, 3))
+    with pytest.raises(ValueError):
+        other.load_weights(tmp_path / "w.h5")
