@@ -722,8 +722,10 @@ class Model:
             tree = loaded[0] if isinstance(loaded, tuple) else loaded
         else:
             tree, _ = ckpt.import_hdf5(path)
-        if set(tree) == {"params", "state"}:
-            params, state = tree["params"], tree["state"]
+        if "params" in tree and set(tree) <= {"params", "state"}:
+            # save_weights wrapper. A stateless model's empty state dict is
+            # dropped by the flat file format, so "state" may be absent.
+            params, state = tree["params"], tree.get("state")
         else:  # bare params interchange
             params, state = tree, None
         ref = jax.tree_util.tree_structure(self.params)
@@ -737,8 +739,11 @@ class Model:
         )
         if state is not None:
             self.state = self.strategy.put_params(state)
-        # Placements changed: every cached compiled step is stale.
+        # Placements (and possibly dtypes) changed: every cached compiled
+        # step is stale, as is the memoized decode dtype (mirrors build()).
         self._train_step = self._eval_step = self._predict_step = None
+        self._decode_dtype = None
+        self._generate_fns = {}
         if self.compiled:
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self

@@ -1,3 +1,11 @@
-from . import logging, profiler, tree
+from . import logging, profiler, sync_check, tree
+from .sync_check import assert_replicas_identical, replica_drift
 
-__all__ = ["logging", "profiler", "tree"]
+__all__ = [
+    "logging",
+    "profiler",
+    "sync_check",
+    "tree",
+    "assert_replicas_identical",
+    "replica_drift",
+]

@@ -1,0 +1,84 @@
+"""Replica-synchronization checking.
+
+The reference's only distributed-correctness signal is observational: all
+Spark workers report the same accuracy (/root/reference/README.md:226-232).
+This module turns that invariant into a callable check users (and the
+driver's dryrun) can run at any point in training: under synchronous data
+parallelism every replicated parameter must stay BIT-identical across its
+shards — any drift means non-deterministic math or a broken collective.
+
+``assert_replicas_identical`` is exact and raises; ``replica_drift``
+reports the worst divergence per parameter for debugging (0.0 everywhere
+on a healthy run).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import numpy as np
+
+
+def _replicated_groups(leaf):
+    """Group a sharded array's addressable shards by the device subset that
+    should hold identical data: shards whose index (slice tuple) is equal
+    are replicas of the same logical block."""
+    groups: Dict[tuple, list] = {}
+    for s in leaf.addressable_shards:
+        key = tuple(
+            (sl.start, sl.stop, sl.step) for sl in s.index
+        ) if s.index else ()
+        groups.setdefault(key, []).append(s)
+    return groups
+
+
+def replica_drift(params) -> Dict[str, float]:
+    """Max |difference| across replicas for every param with >1 replica.
+
+    Keys are '/'-joined tree paths; values are 0.0 when bit-identical.
+    Params sharded without replication (e.g. fully FSDP-sharded leaves)
+    have no replicas to compare and are omitted.
+    """
+    out: Dict[str, float] = {}
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        worst = None
+        for shards in _replicated_groups(leaf).values():
+            if len(shards) < 2:
+                continue
+            base = np.asarray(shards[0].data)
+            for other in shards[1:]:
+                d = np.max(np.abs(
+                    base.astype(np.float64)
+                    - np.asarray(other.data).astype(np.float64)
+                )) if base.size else 0.0
+                worst = d if worst is None else max(worst, d)
+        if worst is not None:
+            out[jax.tree_util.keystr(path)] = float(worst)
+    return out
+
+
+def assert_replicas_identical(params, what: str = "params") -> None:
+    """Raise AssertionError naming the first parameter whose replicas have
+    diverged (bit-exact comparison — synchronous DP guarantees identity,
+    not closeness)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for shards in _replicated_groups(leaf).values():
+            if len(shards) < 2:
+                continue
+            base = np.asarray(shards[0].data)
+            for other in shards[1:]:
+                if not np.array_equal(
+                    base, np.asarray(other.data), equal_nan=True
+                ):
+                    raise AssertionError(
+                        f"Replica divergence in {what} at "
+                        f"{jax.tree_util.keystr(path)}: device "
+                        f"{shards[0].device} != {other.device}"
+                    )

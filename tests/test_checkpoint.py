@@ -144,3 +144,26 @@ def test_save_load_weights_convenience(tmp_path):
     other.build((32, 32, 3))
     with pytest.raises(ValueError):
         other.load_weights(tmp_path / "w.h5")
+
+
+def test_save_load_weights_stateless_model(tmp_path):
+    """A model with no stateful layers (empty state tree) must round-trip:
+    the flat file format drops empty dicts, so the loader tolerates a
+    missing 'state' key."""
+    def build():
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+        m.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy")
+        m.build((28, 28, 1), seed=11)
+        return m
+
+    m = build()
+    x = np.random.default_rng(1).standard_normal((8, 28, 28, 1)).astype(
+        np.float32)
+    want = m.predict(x, batch_size=8)
+    for fname in ("sl.h5", "sl.npz"):
+        m.save_weights(tmp_path / fname)
+        fresh = build()
+        fresh.load_weights(tmp_path / fname)
+        np.testing.assert_allclose(fresh.predict(x, batch_size=8), want,
+                                   rtol=1e-5, atol=1e-5)
