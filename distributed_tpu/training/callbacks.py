@@ -240,3 +240,29 @@ class ProfilerCallback(Callback):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+
+
+class SyncCheck(Callback):
+    """Assert the synchronous-DP replica-identity invariant during training
+    (the reference's only distributed-correctness signal, observed manually
+    at /root/reference/README.md:226-232, as an automated in-training
+    check). Verifies every replicated parameter is bit-identical across
+    its replicas at the end of each ``every``-th epoch — catching
+    non-deterministic math or a broken collective at the epoch it happens
+    instead of at final-metrics divergence."""
+
+    def __init__(self, every: int = 1, include_opt_state: bool = False):
+        if every < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.every = int(every)
+        self.include_opt_state = bool(include_opt_state)
+
+    def on_epoch_end(self, model, epoch, logs):
+        if (epoch + 1) % self.every:
+            return
+        from ..utils.sync_check import assert_replicas_identical
+
+        assert_replicas_identical(model.params, "params")
+        assert_replicas_identical(model.state, "state")
+        if self.include_opt_state:
+            assert_replicas_identical(model.opt_state, "opt_state")

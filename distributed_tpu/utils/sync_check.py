@@ -51,10 +51,17 @@ def replica_drift(params) -> Dict[str, float]:
                 continue
             base = np.asarray(shards[0].data)
             for other in shards[1:]:
-                d = np.max(np.abs(
-                    base.astype(np.float64)
-                    - np.asarray(other.data).astype(np.float64)
-                )) if base.size else 0.0
+                o = np.asarray(other.data)
+                if base.size == 0:
+                    d = 0.0
+                else:
+                    bf = base.astype(np.float64)
+                    of = o.astype(np.float64)
+                    # Matching NaN/inf pairs are in sync (drift 0), matching
+                    # assert_replicas_identical's equal_nan semantics; a
+                    # finite-vs-inf mismatch still reports inf.
+                    same = (bf == of) | (np.isnan(bf) & np.isnan(of))
+                    d = float(np.max(np.where(same, 0.0, np.abs(bf - of))))
                 worst = d if worst is None else max(worst, d)
         if worst is not None:
             out[jax.tree_util.keystr(path)] = float(worst)

@@ -142,3 +142,18 @@ early_stopping_callback <- function(monitor = "loss", patience = 0L,
 
 #' @export
 csv_logger_callback <- function(path) dtpu()$callbacks$CSVLogger(path)
+
+#' Keras-style weight round-trip (params AND BatchNorm running stats);
+#' writes npz instead of HDF5 when the path ends in .npz.
+#' @export
+save_model_weights_hdf5 <- function(object, filepath) {
+  object$save_weights(filepath)
+  invisible(filepath)
+}
+
+#' Load weights saved by save_model_weights_hdf5 into a built model.
+#' @export
+load_model_weights_hdf5 <- function(object, filepath) {
+  object$load_weights(filepath)
+  invisible(object)
+}

@@ -483,6 +483,16 @@ class RBinding:
         )
         return object
 
+    # model.R:147-150
+    def save_model_weights_hdf5(self, object, filepath):
+        object.attr("save_weights")(filepath)
+        return filepath
+
+    # model.R:154-157
+    def load_model_weights_hdf5(self, object, filepath):
+        object.attr("load_weights")(filepath)
+        return object
+
     # model.R:128-133
     def model_checkpoint_callback(self, directory, save_freq=r_character("epoch"),
                                   keep=r_int(3), restore=r_logical(False)):

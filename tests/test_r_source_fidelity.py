@@ -44,6 +44,8 @@ MAPPING = {
     "summary_model": "summary_model",
     "save_model_hdf5": "save_model_hdf5",
     "load_model_hdf5": "load_model_hdf5",
+    "save_model_weights_hdf5": "save_model_weights_hdf5",
+    "load_model_weights_hdf5": "load_model_weights_hdf5",
     "model_checkpoint_callback": "model_checkpoint_callback",
     "early_stopping_callback": "early_stopping_callback",
     "csv_logger_callback": "csv_logger_callback",

@@ -375,6 +375,30 @@ def test_distributed_2proc_r_flow(tmp_path):
     assert len(accs) == 1  # replicas identical, README.md:226-232
 
 
+def test_weights_save_load_roundtrip_from_r(rb, tmp_path):
+    """save_model_weights_hdf5 / load_model_weights_hdf5: the Keras-named
+    weight round-trip (params + state) driven through R marshaling."""
+    d = rb.dataset_mnist()
+    train = d.get("train")
+    x, y = train.get("x"), train.get("y")
+    model = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model, learning_rate=r_double(0.05))
+    _fit_small(rb, model, x, y)
+
+    path = str(tmp_path / "weights.hdf5")
+    rb.save_model_weights_hdf5(model, r_character(path))
+
+    xs = RArray(x.array[:32], "double")
+    before = rb.predict_on_batch(model, xs).array
+
+    model2 = rb.dtpu_model(rb.mnist_cnn())
+    rb.compile(model2, learning_rate=r_double(0.05))
+    model2._obj.build((28, 28, 1))
+    rb.load_model_weights_hdf5(model2, r_character(path))
+    after = rb.predict_on_batch(model2, xs).array
+    np.testing.assert_allclose(before, after, atol=1e-5)
+
+
 # -- keep last: coverage over every dtpu()$... call site --------------------
 
 

@@ -53,3 +53,21 @@ def test_unsharded_arrays_are_ignored():
     params = {"w": np.ones((4,), np.float32)}
     assert replica_drift(params) == {}
     assert_replicas_identical(params)  # no-op, no raise
+
+
+def test_sync_check_callback_passes_on_healthy_run_and_validates():
+    SyncCheck = dtpu.callbacks.SyncCheck
+
+    strategy = dtpu.DataParallel()
+    with strategy.scope():
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+        m.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy")
+    x, y = dtpu.data.synthetic_images(64, (28, 28), 10, 0)
+    x = x[..., None].astype(np.float32) / 255.0
+    h = m.fit(x, y.astype(np.int32), batch_size=64, epochs=2,
+              steps_per_epoch=2, verbose=0, seed=0,
+              callbacks=[SyncCheck(every=1, include_opt_state=True)])
+    assert np.isfinite(h.history["loss"]).all()
+    with pytest.raises(ValueError):
+        SyncCheck(every=0)
