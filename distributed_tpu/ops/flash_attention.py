@@ -749,7 +749,7 @@ def _dense_fallback(q, k, v, causal):
 
 def flash_attention(
     q, k, v, *, causal: bool = False,
-    block_q: int = 256, block_k: int = 512,
+    block_q: int = 256, block_k: int = 1024,
 ):
     """softmax(Q K^T / sqrt(d)) V without materializing the (T, T) scores.
 

@@ -33,6 +33,23 @@ def _replicated_groups(leaf):
     return groups
 
 
+def _is_full_extent(key, shape) -> bool:
+    """True when a shard-index key (from _replicated_groups) spans the
+    whole array — i.e. the shard IS the full logical value."""
+    if key == ():
+        return True
+    if len(key) != len(shape):
+        return False
+    for (start, stop, step), dim in zip(key, shape):
+        if (start or 0) != 0:
+            return False
+        if stop is not None and stop != dim:
+            return False
+        if step not in (None, 1):
+            return False
+    return True
+
+
 def replica_drift(params) -> Dict[str, float]:
     """Max |difference| across replicas for every param with >1 replica.
 
@@ -58,27 +75,39 @@ def replica_drift(params) -> Dict[str, float]:
                     bf = base.astype(np.float64)
                     of = o.astype(np.float64)
                     # Matching NaN/inf pairs are in sync (drift 0), matching
-                    # assert_replicas_identical's equal_nan semantics; a
-                    # finite-vs-inf mismatch still reports inf.
+                    # assert_replicas_identical's equal_nan semantics; any
+                    # mismatch involving NaN/inf reports inf (NaN must not
+                    # leak into the max, where it would compare as False
+                    # and mask real divergence).
                     same = (bf == of) | (np.isnan(bf) & np.isnan(of))
-                    d = float(np.max(np.where(same, 0.0, np.abs(bf - of))))
+                    diff = np.nan_to_num(np.abs(bf - of), nan=np.inf)
+                    d = float(np.max(np.where(same, 0.0, diff)))
                 worst = d if worst is None else max(worst, d)
         if worst is not None:
             out[jax.tree_util.keystr(path)] = float(worst)
     return out
 
 
-def assert_replicas_identical(params, what: str = "params") -> None:
+def assert_replicas_identical(params, what: str = "params",
+                              cross_host: bool = True) -> None:
     """Raise AssertionError naming the first parameter whose replicas have
     diverged (bit-exact comparison — synchronous DP guarantees identity,
-    not closeness)."""
+    not closeness).
+
+    Process-local replicas are compared byte-for-byte. With
+    ``cross_host=True`` (default) and >1 process, replicas held by OTHER
+    hosts are compared via allgathered per-shard fingerprints — one chip
+    per host is the common TPU layout, where the local check alone would
+    have nothing to compare. Every process must call this (the gather is
+    collective)."""
+    import zlib
+
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    fingerprints = {}
     for path, leaf in flat:
         if not hasattr(leaf, "addressable_shards"):
             continue
-        for shards in _replicated_groups(leaf).values():
-            if len(shards) < 2:
-                continue
+        for key, shards in _replicated_groups(leaf).items():
             base = np.asarray(shards[0].data)
             for other in shards[1:]:
                 if not np.array_equal(
@@ -89,3 +118,35 @@ def assert_replicas_identical(params, what: str = "params") -> None:
                         f"{jax.tree_util.keystr(path)}: device "
                         f"{shards[0].device} != {other.device}"
                     )
+            # Cross-host comparison only for FULLY replicated groups: a
+            # full-extent shard means every process holds this exact
+            # logical block, so the fingerprint list (and its ordering)
+            # is identical on all processes. Partially sharded leaves
+            # (FSDP/TP splits) hold different blocks per host — their
+            # group keys would misalign the gather.
+            if _is_full_extent(key, leaf.shape):
+                name = jax.tree_util.keystr(path)
+                fingerprints[name] = np.uint32(
+                    zlib.crc32(np.ascontiguousarray(base).tobytes())
+                )
+    if not cross_host or jax.process_count() < 2 or not fingerprints:
+        return
+    from jax.experimental import multihost_utils
+
+    names = sorted(fingerprints)
+    local = np.asarray([fingerprints[n] for n in names], np.uint32)
+    gathered = np.asarray(multihost_utils.process_allgather(local))
+    # gathered: (process_count, n_leaves). A shard-index group replicated
+    # across hosts must fingerprint identically everywhere it appears;
+    # legitimately different shards (FSDP/TP splits) have different group
+    # keys per host only when their index tuples differ — identical keys
+    # mean identical logical blocks.
+    for col, name in enumerate(names):
+        vals = gathered[:, col]
+        if (vals != vals[0]).any():
+            bad = int(np.argmax(vals != vals[0]))
+            raise AssertionError(
+                f"Cross-host replica divergence in {what} at {name}: "
+                f"process 0 fingerprint {vals[0]:#x} != process {bad} "
+                f"fingerprint {vals[bad]:#x}"
+            )

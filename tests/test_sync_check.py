@@ -71,3 +71,65 @@ def test_sync_check_callback_passes_on_healthy_run_and_validates():
     assert np.isfinite(h.history["loss"]).all()
     with pytest.raises(ValueError):
         SyncCheck(every=0)
+
+
+def test_cross_host_divergence_caught_via_launcher(tmp_path):
+    """2-process gang (1 CPU device each): the local replica check has
+    nothing to compare, so only the cross-host fingerprint path can catch
+    rank-1 perturbing its weights after training."""
+    import subprocess  # noqa: F401 (parity with test_launch style)
+    import sys
+    import textwrap
+    from pathlib import Path
+
+    from distributed_tpu.launch import LocalLauncher
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        sys.path.insert(0, __REPO__)
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import distributed_tpu as dtpu
+        from distributed_tpu.launch import report_result
+        from distributed_tpu.utils import assert_replicas_identical
+
+        spec = dtpu.cluster.initialize()
+        x, y = dtpu.data.synthetic_images(64, (28, 28), 10, 0)
+        x = x[..., None].astype(np.float32) / 255.0
+        strategy = dtpu.DataParallel()
+        with strategy.scope():
+            m = dtpu.Model(dtpu.models.mnist_cnn())
+            m.compile(optimizer=dtpu.optim.SGD(0.05), metrics=["accuracy"])
+        m.fit(x, y.astype(np.int32), batch_size=64, epochs=1,
+              steps_per_epoch=2, verbose=0, seed=0)
+
+        assert_replicas_identical(m.params)  # healthy: must pass
+
+        # Rank 1 corrupts one weight via a purely process-local
+        # reconstruction (a device_put onto the cross-process sharding
+        # would itself be a collective and desync the gang).
+        if spec.index == 1:
+            leaf = m.params["dense"]["bias"]
+            shard = leaf.addressable_shards[0]
+            buf = jax.device_put(np.asarray(shard.data) + 1.0, shard.device)
+            m.params["dense"]["bias"] = (
+                jax.make_array_from_single_device_arrays(
+                    leaf.shape, leaf.sharding, [buf]))
+        try:
+            assert_replicas_identical(m.params)
+            report_result({"caught": False})
+        except AssertionError as e:
+            report_result({"caught": True, "msg": str(e)[:120]})
+    """).replace("__REPO__", repr(repo)))
+    results = LocalLauncher().run([sys.executable, str(script)], 2,
+                                  timeout=300)
+    assert all(r.ok for r in results), [
+        (r.index, r.error, r.log_tail[-400:]) for r in results
+    ]
+    for r in results:
+        assert r.value["caught"], r.value
+        assert "dense" in r.value["msg"]
