@@ -20,6 +20,8 @@ TPU notes:
 
 from __future__ import annotations
 
+import functools
+
 from typing import Optional, Sequence
 
 from .. import nn
@@ -35,42 +37,48 @@ _CONFIGS = {
 _STAGE_WIDTHS = (64, 128, 256, 512)
 
 
-def _conv_bn(filters, kernel, strides=1, activation=None, dtype=None):
+def _conv_bn(filters, kernel, strides=1, activation=None, dtype=None,
+             bn_shift="data"):
     layers = [
         nn.Conv2D(filters, kernel, strides=strides, padding="same",
                   use_bias=False, dtype=dtype),
-        nn.BatchNorm(),
+        nn.BatchNorm(stats_shift=bn_shift),
     ]
     if activation is not None:
         layers.append(nn.Activation(activation))
     return layers
 
 
-def _projection(filters, strides, dtype):
+def _projection(filters, strides, dtype, bn_shift):
     return nn.Sequential(
-        _conv_bn(filters, 1, strides=strides, dtype=dtype), name="shortcut"
+        _conv_bn(filters, 1, strides=strides, dtype=dtype, bn_shift=bn_shift),
+        name="shortcut",
     )
 
 
-def _basic_block(filters, strides, project, dtype):
+def _basic_block(filters, strides, project, dtype, bn_shift):
     main = nn.Sequential(
-        _conv_bn(filters, 3, strides=strides, activation="relu", dtype=dtype)
-        + _conv_bn(filters, 3, dtype=dtype),
+        _conv_bn(filters, 3, strides=strides, activation="relu", dtype=dtype,
+                 bn_shift=bn_shift)
+        + _conv_bn(filters, 3, dtype=dtype, bn_shift=bn_shift),
         name="main",
     )
-    shortcut = _projection(filters, strides, dtype) if project else None
+    shortcut = (_projection(filters, strides, dtype, bn_shift)
+                if project else None)
     return nn.Residual(main, shortcut, activation="relu")
 
 
-def _bottleneck_block(filters, strides, project, dtype):
+def _bottleneck_block(filters, strides, project, dtype, bn_shift):
     out = filters * 4
     main = nn.Sequential(
-        _conv_bn(filters, 1, activation="relu", dtype=dtype)
-        + _conv_bn(filters, 3, strides=strides, activation="relu", dtype=dtype)  # v1.5
-        + _conv_bn(out, 1, dtype=dtype),
+        _conv_bn(filters, 1, activation="relu", dtype=dtype,
+                 bn_shift=bn_shift)
+        + _conv_bn(filters, 3, strides=strides, activation="relu",
+                   dtype=dtype, bn_shift=bn_shift)  # v1.5
+        + _conv_bn(out, 1, dtype=dtype, bn_shift=bn_shift),
         name="main",
     )
-    shortcut = _projection(out, strides, dtype) if project else None
+    shortcut = _projection(out, strides, dtype, bn_shift) if project else None
     return nn.Residual(main, shortcut, activation="relu")
 
 
@@ -83,13 +91,15 @@ def resnet(
     width: int = 64,
     stem: str = "conv7",
     scan_stages: bool = False,
+    bn_shift: str = "running",
     dtype=None,
 ) -> nn.Sequential:
     if depth not in _CONFIGS:
         raise ValueError(f"Unsupported depth {depth}; known: {sorted(_CONFIGS)}")
     kind, default_blocks = _CONFIGS[depth]
     blocks = tuple(stage_blocks) if stage_blocks is not None else default_blocks
-    make = _basic_block if kind == "basic" else _bottleneck_block
+    base = _basic_block if kind == "basic" else _bottleneck_block
+    make = functools.partial(base, bn_shift=bn_shift)
     expansion = 1 if kind == "basic" else 4
 
     if stem not in ("conv7", "space_to_depth"):
@@ -102,7 +112,8 @@ def resnet(
                 "small_inputs=True uses the CIFAR 3x3 stem; it is "
                 f"incompatible with stem={stem!r}"
             )
-        layers = _conv_bn(width, 3, activation="relu", dtype=dtype)
+        layers = _conv_bn(width, 3, activation="relu", dtype=dtype,
+                          bn_shift=bn_shift)
     elif stem == "space_to_depth":
         # TPU stem: space-to-depth(2) then a 4x4/1 conv on 12 channels.
         # Same downsampling and output shape as conv7 (112x112xW before the
@@ -112,10 +123,12 @@ def resnet(
         # receptive field (superset of the padded 7x7), so this is a
         # reparametrization, not an approximation.
         layers = [nn.SpaceToDepth(2)]
-        layers += _conv_bn(width, 4, activation="relu", dtype=dtype)
+        layers += _conv_bn(width, 4, activation="relu", dtype=dtype,
+                           bn_shift=bn_shift)
         layers.append(nn.MaxPool2D(3, strides=2, padding="same"))
     else:  # "conv7": the reference-style ImageNet stem
-        layers = _conv_bn(width, 7, strides=2, activation="relu", dtype=dtype)
+        layers = _conv_bn(width, 7, strides=2, activation="relu",
+                          dtype=dtype, bn_shift=bn_shift)
         layers.append(nn.MaxPool2D(3, strides=2, padding="same"))
 
     in_ch = width

@@ -358,9 +358,19 @@ class BatchNorm(Layer):
     # Class-level default for the batch-stats reduction strategy:
     # "reduce" (jnp.mean) or "dot" (matmul against ones — see apply()).
     stats_impl = "reduce"
+    # Where the conditioning shift for the single-pass moments comes from:
+    # "data" (per-channel mean of the first batch element — valid on any
+    # input but SERIALIZES conv -> slice-reduce -> stats, so XLA cannot fuse
+    # the stat reductions into the producing conv's epilogue) or "running"
+    # (the running mean from state — a constant w.r.t. this batch, so the
+    # stats become epilogue siblings of the producer and the activation is
+    # never re-read from HBM for statistics; measured ~26% off a
+    # conv+BN site's device time, examples/profile_resnet_xplane.py).
+    stats_shift = "data"
 
     def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
-                 stats_impl: Optional[str] = None, name=None):
+                 stats_impl: Optional[str] = None,
+                 stats_shift: Optional[str] = None, name=None):
         super().__init__(name)
         self.momentum = float(momentum)
         self.epsilon = float(epsilon)
@@ -370,6 +380,13 @@ class BatchNorm(Layer):
                     f"stats_impl must be 'reduce' or 'dot', got {stats_impl!r}"
                 )
             self.stats_impl = stats_impl
+        if stats_shift is not None:
+            if stats_shift not in ("data", "running"):
+                raise ValueError(
+                    f"stats_shift must be 'data' or 'running', got "
+                    f"{stats_shift!r}"
+                )
+            self.stats_shift = stats_shift
 
     def init(self, key, input_shape: Shape):
         c = input_shape[-1]
@@ -396,12 +413,20 @@ class BatchNorm(Layer):
             # first step, when the running mean is still 0).
             # _bn_norm's custom VJP returns zero cotangents for the stats,
             # so autodiff keeps no residual of these reductions.
-            shift = lax.stop_gradient(
-                jnp.mean(
-                    x[:1].astype(jnp.float32),
-                    axis=tuple(range(x.ndim - 1)),
+            # stats_shift="running" uses the running mean instead of a
+            # data-derived shift: exact-arithmetic-identical (mean =
+            # shift + mean(x - shift) for ANY shift), and because it is
+            # constant w.r.t. the batch the reductions fuse into the
+            # producing conv's epilogue instead of re-reading x. The
+            # conditioning guarantee is weaker only while the running mean
+            # is far from the batch mean (i.e. the first few steps, where
+            # activations are near zero-mean anyway).
+            if self.stats_shift == "running":
+                shift = lax.stop_gradient(state["mean"])
+            else:
+                shift = lax.stop_gradient(
+                    jnp.mean(x[:1].astype(jnp.float32), axis=reduce_axes)
                 )
-            )
             if self.stats_impl == "dot":
                 # Reduce via a dot against ones: XLA's reduce of a large
                 # NHWC activation runs well below HBM bandwidth on some

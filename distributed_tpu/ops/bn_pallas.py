@@ -1,0 +1,154 @@
+"""Pallas TPU kernels for BatchNorm batch statistics and backward reductions.
+
+Round-3 profiling (docs/PERF.md) attributed ~27 ms of ResNet-50's ~100 ms
+step to BN stat reductions running at ~155 GB/s — well under the ~370+ GB/s
+this runtime streams large fused elementwise ops at (examples/
+profile_op_floor.py). These kernels replace XLA's convert+reduce fusions
+with single-pass accumulations over (block, C) tiles in VMEM:
+
+- ``bn_stats(x2d, shift)``      -> (sum(xc), sum(xc^2)) per channel, one read
+  of the activation. ``shift`` is a per-channel mean estimate used purely for
+  numerical conditioning (same scheme as ``nn.layers.BatchNorm``: variance is
+  computed on shifted values so E[xc^2] - E[xc]^2 never cancels).
+- ``bn_bwd_reduce(dy2d, x2d, mean, inv)`` -> (sum(dy), sum(dy*xhat)) per
+  channel, one read of dy and x.
+
+Lane folding: the hottest ResNet BNs sit on C=64 channels, which fills only
+half of the TPU's 128-lane registers — for C dividing 128 the wrapper
+bitcasts (M, C) to (M/k, 128) (row-major contiguity makes columns
+``[C*j : C*(j+1)]`` the same channels, j = 0..k-1) and folds the k partial
+sums after the kernel, recovering full lane utilization.
+
+The reference's equivalent lives inside TF's fused-BN CUDA/C++ kernels
+(SURVEY.md §2b D3/D4); this is the TPU-native answer. CPU/tests run in
+Pallas interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# Candidate row-block sizes, largest first. M = N*H*W for conv activations
+# is a multiple of the batch size, so one of these always divides it in
+# practice; otherwise the caller falls back to the XLA path.
+_BLOCK_ROWS = (8192, 4096, 2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+
+# Keep a block's bf16 bytes within a conservative VMEM slice (the stats
+# kernel holds the block plus one f32 temporary).
+_BLOCK_BYTES = 2 << 20
+
+
+def _pick_block(m: int, c: int, itemsize: int, ninputs: int = 1):
+    for bm in _BLOCK_ROWS:
+        if m % bm == 0 and bm * c * itemsize * ninputs <= _BLOCK_BYTES:
+            return bm
+    return None
+
+
+def _fold(x2d):
+    """Bitcast (M, C) to (M/k, C*k) with C*k == 128 when C divides 128."""
+    m, c = x2d.shape
+    if c < 128 and 128 % c == 0:
+        k = 128 // c
+        if m % k == 0:
+            return x2d.reshape(m // k, 128), k
+    return x2d, 1
+
+
+def _unfold_sums(sums, c, k):
+    # (rows, C*k) partial sums -> (rows, C): columns j*C..(j+1)*C are the
+    # same channels seen by different row subsets.
+    if k == 1:
+        return sums
+    return sums.reshape(sums.shape[0], k, c).sum(axis=1)
+
+
+def _stats_kernel(x_ref, shift_ref, o_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    xc = x_ref[...].astype(jnp.float32) - shift_ref[...]
+    s1 = jnp.sum(xc, axis=0, keepdims=True)
+    s2 = jnp.sum(xc * xc, axis=0, keepdims=True)
+    o_ref[...] += jnp.concatenate([s1, s2], axis=0)
+
+
+def bn_stats(x2d, shift):
+    """One-pass per-channel (sum, sumsq) of ``x2d - shift``.
+
+    x2d: (M, C) activation (any float dtype), shift: (C,) float32.
+    Returns (2, C) float32: row 0 = sum(xc), row 1 = sum(xc*xc).
+    Returns None when no block size divides M (caller falls back to XLA).
+    """
+    m, c = x2d.shape
+    xf, k = _fold(x2d)
+    mf, cf = xf.shape
+    bm = _pick_block(mf, cf, x2d.dtype.itemsize)
+    if bm is None:
+        return None
+    shift_f = jnp.tile(shift.astype(jnp.float32), k)[None, :]
+    sums = pl.pallas_call(
+        _stats_kernel,
+        grid=(mf // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, cf), lambda i: (i, 0)),
+            pl.BlockSpec((1, cf), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, cf), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, cf), jnp.float32),
+        interpret=_interpret(),
+    )(xf, shift_f)
+    return _unfold_sums(sums, c, k)
+
+
+def _bwd_kernel(dy_ref, x_ref, mean_ref, inv_ref, o_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    dy = dy_ref[...].astype(jnp.float32)
+    xhat = (x_ref[...].astype(jnp.float32) - mean_ref[...]) * inv_ref[...]
+    dbias = jnp.sum(dy, axis=0, keepdims=True)
+    dscale = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    o_ref[...] += jnp.concatenate([dbias, dscale], axis=0)
+
+
+def bn_bwd_reduce(dy2d, x2d, mean, inv):
+    """One-pass per-channel (sum(dy), sum(dy * xhat)), xhat=(x-mean)*inv.
+
+    dy2d/x2d: (M, C); mean/inv: (C,) float32. Returns (2, C) float32 or
+    None when no block size divides M.
+    """
+    m, c = x2d.shape
+    xf, k = _fold(x2d)
+    dyf, _ = _fold(dy2d)
+    mf, cf = xf.shape
+    bm = _pick_block(mf, cf, x2d.dtype.itemsize, ninputs=2)
+    if bm is None:
+        return None
+    mean_f = jnp.tile(mean.astype(jnp.float32), k)[None, :]
+    inv_f = jnp.tile(inv.astype(jnp.float32), k)[None, :]
+    sums = pl.pallas_call(
+        _bwd_kernel,
+        grid=(mf // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, cf), lambda i: (i, 0)),
+            pl.BlockSpec((bm, cf), lambda i: (i, 0)),
+            pl.BlockSpec((1, cf), lambda i: (0, 0)),
+            pl.BlockSpec((1, cf), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, cf), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, cf), jnp.float32),
+        interpret=_interpret(),
+    )(dyf, xf, mean_f, inv_f)
+    return _unfold_sums(sums, c, k)

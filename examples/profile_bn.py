@@ -1,0 +1,112 @@
+"""A/B the Pallas BN kernels against XLA's reduce fusions per ResNet shape.
+
+For each (M, C) BatchNorm site in ResNet-50 @ 224/batch-256, times the
+forward batch-stats reduction and the backward (dbias, dscale) reduction in
+both implementations, with differential (latency-cancelled) timing.
+
+Usage: python examples/profile_bn.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_tpu.ops import bn_pallas
+
+
+def sync1(v):
+    np.asarray(jax.device_get(jnp.ravel(v)[:1]))
+
+
+def timeit(fn, args, warmup=2, n1=20, n2=120, trials=2):
+    def run(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        sync1(jax.tree_util.tree_leaves(out)[0])
+        return time.perf_counter() - t0
+
+    for _ in range(warmup):
+        out = fn(*args)
+    sync1(jax.tree_util.tree_leaves(out)[0])
+    run(n1)
+    best = float("inf")
+    for _ in range(trials):
+        t1 = run(n1)
+        t2 = run(n2)
+        best = min(best, max(t2 - t1, 1e-9) / (n2 - n1))
+    return best
+
+
+# (M, C, count) — count = how many BN layers share this activation shape
+SHAPES = [
+    (256 * 112 * 112, 64, 1),    # stem
+    (256 * 56 * 56, 64, 7),      # stage1 1x1/3x3
+    (256 * 56 * 56, 256, 4),     # stage1 out + shortcut
+    (256 * 28 * 28, 128, 8),
+    (256 * 28 * 28, 512, 5),
+    (256 * 14 * 14, 256, 12),
+    (256 * 14 * 14, 1024, 7),
+    (256 * 7 * 7, 512, 6),
+    (256 * 7 * 7, 2048, 4),
+]
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    tot = {"xla_f": 0.0, "pl_f": 0.0, "xla_b": 0.0, "pl_b": 0.0}
+    print(f"{'shape':>18} {'xla fwd':>9} {'pl fwd':>9} {'xla bwd':>9} "
+          f"{'pl bwd':>9}  (ms, per layer)", flush=True)
+    for m, c, count in SHAPES:
+        x = (jax.random.normal(key, (m, c), jnp.float32) * 2 + 3).astype(
+            jnp.bfloat16)
+        dy = jax.random.normal(key, (m, c), jnp.bfloat16)
+        shift = jax.random.normal(key, (c,), jnp.float32)
+        mean = jax.random.normal(key, (c,), jnp.float32)
+        inv = jnp.abs(jax.random.normal(key, (c,), jnp.float32)) + 0.5
+
+        # XLA forward: the single-pass shifted scheme from nn.layers
+        @jax.jit
+        def xla_stats(x, shift):
+            xc = x.astype(jnp.float32) - shift
+            return jnp.sum(xc, 0), jnp.sum(xc * xc, 0)
+
+        @jax.jit
+        def pl_stats(x, shift):
+            return bn_pallas.bn_stats(x, shift)
+
+        # XLA backward: sibling reductions as in _bn_norm_bwd
+        @jax.jit
+        def xla_bwd(dy, x, mean, inv):
+            xhat = (x.astype(jnp.float32) - mean) * inv
+            dyf = dy.astype(jnp.float32)
+            return jnp.sum(dyf, 0), jnp.sum(dyf * xhat, 0)
+
+        @jax.jit
+        def pl_bwd(dy, x, mean, inv):
+            return bn_pallas.bn_bwd_reduce(dy, x, mean, inv)
+
+        tf_x = timeit(xla_stats, (x, shift))
+        tf_p = timeit(pl_stats, (x, shift))
+        tb_x = timeit(xla_bwd, (dy, x, mean, inv))
+        tb_p = timeit(pl_bwd, (dy, x, mean, inv))
+        gb = m * c * 2 / 1e9
+        print(f"({m:>9},{c:>5})x{count} {tf_x*1e3:8.2f} {tf_p*1e3:8.2f} "
+              f"{tb_x*1e3:8.2f} {tb_p*1e3:8.2f}   "
+              f"[pl fwd {gb/tf_p:5.0f} GB/s, pl bwd {2*gb/tb_p:5.0f} GB/s]",
+              flush=True)
+        tot["xla_f"] += tf_x * count
+        tot["pl_f"] += tf_p * count
+        tot["xla_b"] += tb_x * count
+        tot["pl_b"] += tb_p * count
+    print(f"\nResNet-50 totals (53 BN layers): "
+          f"fwd XLA {tot['xla_f']*1e3:.1f} -> pallas {tot['pl_f']*1e3:.1f} ms; "
+          f"bwd XLA {tot['xla_b']*1e3:.1f} -> pallas {tot['pl_b']*1e3:.1f} ms",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Exact device-time breakdown of the ResNet-50 train step from xplane.
+
+Buckets every XLA op in the profiled step by kind so the MFU work targets
+the real bottleneck (wall-clock A/Bs are noise-bound on this transport).
+
+Usage: python examples/profile_resnet_xplane.py [steps]
+"""
+
+import sys
+
+sys.path.insert(0, "examples")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import distributed_tpu as dtpu
+import xplane_util
+
+BUCKETS = [
+    ("bn-stats/reduce", ["convert_reduce", "reduce"]),
+    ("optimizer", ["multiply_add", "subtract_multiply", "copy_add"]),
+    ("conv", ["convolution"]),
+    ("matmul", ["dot"]),
+    ("select-scatter", ["select_and_scatter", "select-and-scatter"]),
+    ("copy/layout", ["copy", "reshape", "transpose", "bitcast"]),
+    ("residual/ew", ["add_add", "compare_select", "add", "multiply",
+                     "divide", "maximum", "subtract", "rsqrt", "exp",
+                     "log", "compare", "select"]),
+    ("fusion(conv?)", ["fusion"]),
+]
+
+
+def main(steps=5, batch=256, image=224):
+    model = dtpu.Model(dtpu.models.resnet(50, 1000, dtype=jnp.bfloat16))
+    model.compile(optimizer=dtpu.optim.SGD(0.1, momentum=0.9),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.build((image, image, 3))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, image, image, 3),
+                                        dtype=np.float32))
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+    step = model._get_train_step()
+    carry = [model.params, model.state, model.opt_state]
+
+    def once():
+        p, s, o, loss, _ = step(carry[0], carry[1], carry[2], x, y, key)
+        carry[0], carry[1], carry[2] = p, s, o
+        return loss
+
+    once()  # compile
+    np.asarray(jax.device_get(once()))
+
+    table, counts = xplane_util.capture(
+        lambda: [once() for _ in range(steps)])
+    per_step = {k: v / steps for k, v in table.items()}
+    xplane_util.print_table(per_step, counts, top=40)
+    print()
+    b = xplane_util.bucketize(per_step, BUCKETS)
+    total = sum(b.values())
+    for k, v in sorted(b.items(), key=lambda kv: -kv[1]):
+        print(f"{k:<18} {v:8.2f} ms  {v/total*100:5.1f}%")
+    flop = 3.0 * 4.089e9 * batch * (image / 224.0) ** 2
+    print(f"\ndevice total {total:.1f} ms/step -> {flop/total/1e9:.1f} TF/s, "
+          f"MFU {flop/total/1e9/197:.3f}")
+
+
+if __name__ == "__main__":
+    main(*[int(a) for a in sys.argv[1:]])

@@ -131,6 +131,29 @@ def test_batchnorm_stats_match_f32_reference():
         assert (got_var >= 0).all()
 
 
+def test_batchnorm_running_shift_matches_data_shift():
+    """stats_shift='running' is the epilogue-fusable conditioning variant
+    (see nn.layers.BatchNorm.stats_shift); its statistics and outputs must
+    match the data-shift default, including mid-training when the running
+    mean is nonzero."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray((rng.standard_normal((32, 5, 5, 16)) * 2 + 4.0)
+                    .astype(np.float32))
+    warm_state = {"mean": jnp.asarray(rng.standard_normal(16), jnp.float32),
+                  "var": jnp.abs(jnp.asarray(rng.standard_normal(16),
+                                             jnp.float32)) + 0.5}
+    for state0 in ({"mean": jnp.zeros(16), "var": jnp.ones(16)}, warm_state):
+        outs = {}
+        for shift in ("data", "running"):
+            bn = nn.BatchNorm(stats_shift=shift)
+            params, _, _ = bn.init(jax.random.PRNGKey(0), (5, 5, 16))
+            y, new_state = bn.apply(params, state0, x, train=True)
+            outs[shift] = (np.asarray(y), np.asarray(new_state["mean"]),
+                           np.asarray(new_state["var"]))
+        for a, b in zip(outs["data"], outs["running"]):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
 def test_space_to_depth_rearranges_blocks():
     s2d = nn.SpaceToDepth(2)
     _, _, out = s2d.init(jax.random.PRNGKey(0), (4, 6, 3))
