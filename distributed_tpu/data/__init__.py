@@ -3,6 +3,7 @@ from .datasets import (
     load_cifar10,
     load_fashion_mnist,
     load_imagenet,
+    fetch_mnist,
     load_mnist,
     synthetic_images,
 )
@@ -15,6 +16,7 @@ __all__ = [
     "write_shards",
     "native_available",
     "load",
+    "fetch_mnist",
     "load_mnist",
     "load_fashion_mnist",
     "load_cifar10",

@@ -100,10 +100,14 @@ class ScannedBlocks(Layer):
 
     ``block_fn()`` must return a fresh ``Layer`` with identical structure
     each call. Blocks may hold state (running stats); its leaves are
-    stacked with a leading (S, ...) dim like the params. Numerics are
-    identical to the unrolled ``Sequential([block_fn() for _ in range(S)])``
-    given the same per-block parameters (asserted in
-    tests/test_scanned_blocks.py).
+    stacked with a leading (S, ...) dim like the params. Deterministic
+    computation is numerically identical to the unrolled
+    ``Sequential([block_fn() for _ in range(S)])`` given the same per-block
+    parameters (asserted in tests/test_scanned_blocks.py). Rng ROUTING
+    differs, though: apply() splits one key into S per-block streams, while
+    an unrolled Sequential splits across all rng-consuming layers globally —
+    Dropout/augmentation masks therefore differ between the scanned and
+    unrolled forms (each is still a valid i.i.d. mask stream).
     """
 
     # Incremental decode IS supported (unlike PipelinedBlocks): the KV

@@ -774,7 +774,11 @@ def flash_attention(
     b, t, h, d = q.shape
     rt = _round_up(t, 8)
     bq = min(block_q, rt)
-    bk = min(block_k, rt)
+    # Clamp block_k to the q-rounded sequence length: t_pad is a multiple of
+    # max(bq, bk), so an unclamped default (1024) would pad mid-size
+    # sequences (e.g. T=600) up to 2x. With bk <= round_up(t, bq) the padded
+    # work is bounded by one q-block: t_pad <= t + bq.
+    bk = min(block_k, _round_up(t, bq))
     if max(bq, bk) % min(bq, bk):  # clamping broke divisibility
         bq = bk = min(bq, bk)
     if _packed_supported(h, d):

@@ -39,6 +39,7 @@ from ..ops import metrics as metrics_lib
 from ..parallel.strategy import SingleDevice, Strategy, current_strategy
 from ..utils import logging as dlog
 from ..utils.tree import tree_size
+from .progress import ProgressLine
 from .history import History
 
 
@@ -398,7 +399,16 @@ class Model:
             msums: Dict[str, list] = {name: [] for name, _ in self.metric_fns}
             epoch_steps = steps_per_epoch - resume_offset
             resume_offset = 0
-            for _ in range(epoch_steps):
+            bar = None
+            if verbose == 1 and is_chief:
+                # Per-step progress with ETA (the reference's visible Keras
+                # bar). Tracks host dispatch — no device fetches, keeping
+                # the one-host-sync-per-epoch contract; verbose=2 gives
+                # epoch lines only, as in Keras.
+                bar = ProgressLine(
+                    epoch_steps, prefix=f"Epoch {epoch + 1}/{epochs}: "
+                )
+            for step_i in range(epoch_steps):
                 xb, yb = next_batch()
                 batch = self.strategy.put_batch(
                     {"x": xb, "y": yb}, per_host=per_host
@@ -414,6 +424,10 @@ class Model:
                     msums[name].append(mvals[name])
                 for cb in callbacks:
                     cb.on_batch_end(self, self.step, {"loss": loss})
+                if bar is not None:
+                    bar.update(step_i + 1)
+            if bar is not None:
+                bar.close()
             # One host sync per epoch.
             logs = {"loss": float(np.mean(jax.device_get(losses)))}
             for name, pairs in msums.items():
@@ -540,8 +554,14 @@ class Model:
                         batch["m"])
             )
             rows += xb.shape[0]
+        # Report GLOBAL rows: a sharded source yields only this host's
+        # (1/P)-slice of every batch, so scale by the shard count when the
+        # source doesn't carry an explicit global batch_size.
         n = getattr(source, "batch_size", None)
-        n = n * int(steps) if (per_host and n) else rows
+        if per_host:
+            n = n * int(steps) if n else rows * int(source.shard[1])
+        else:
+            n = rows
         return self._finish_eval(results, n, verbose)
 
     def _finish_eval(self, results, n, verbose):
@@ -734,6 +754,27 @@ class Model:
             raise ValueError(
                 f"Loaded weight tree does not match the model: {got} vs {ref}"
             )
+        # Shape-check every leaf up front: a same-architecture-different-
+        # width file would otherwise load silently and fail later with an
+        # opaque shape error inside the jitted step.
+        for (kpath, have), want in zip(
+            jax.tree_util.tree_leaves_with_path(self.params),
+            jax.tree_util.tree_leaves(params),
+        ):
+            if tuple(have.shape) != tuple(want.shape):
+                raise ValueError(
+                    f"Loaded weight shape mismatch at "
+                    f"{jax.tree_util.keystr(kpath)}: file has "
+                    f"{tuple(want.shape)}, model expects {tuple(have.shape)}"
+                )
+        if state is not None:
+            sref = jax.tree_util.tree_structure(self.state)
+            sgot = jax.tree_util.tree_structure(state)
+            if sref != sgot:
+                raise ValueError(
+                    f"Loaded state tree does not match the model: "
+                    f"{sgot} vs {sref}"
+                )
         self.params = self.strategy.put_params(
             params, self.module.sharding_hints()
         )

@@ -129,11 +129,31 @@ def assert_replicas_identical(params, what: str = "params",
                 fingerprints[name] = np.uint32(
                     zlib.crc32(np.ascontiguousarray(base).tobytes())
                 )
-    if not cross_host or jax.process_count() < 2 or not fingerprints:
+    if not cross_host or jax.process_count() < 2:
         return
     from jax.experimental import multihost_utils
 
+    # Every process participates in the SAME gather sequence even with zero
+    # local fingerprints — an early return decided from local shard layouts
+    # would deadlock the gang if placements ever differed per process.
+    # Gather (count, names-crc) first: agreement makes the value gather
+    # below shape- and order-safe; disagreement is itself a reportable
+    # placement asymmetry rather than a hang.
     names = sorted(fingerprints)
+    names_crc = np.uint32(zlib.crc32("\x00".join(names).encode()))
+    header = np.asarray([np.uint32(len(names)), names_crc], np.uint32)
+    headers = np.asarray(multihost_utils.process_allgather(header))
+    if (headers != headers[0]).any():
+        bad = int(np.argmax((headers != headers[0]).any(axis=1)))
+        raise AssertionError(
+            f"Cross-host placement asymmetry in {what}: process 0 has "
+            f"{int(headers[0, 0])} fully-replicated leaves (names crc "
+            f"{int(headers[0, 1]):#x}), process {bad} has "
+            f"{int(headers[bad, 0])} (crc {int(headers[bad, 1]):#x}) — "
+            "replica comparison requires SPMD-symmetric placements"
+        )
+    if not names:
+        return
     local = np.asarray([fingerprints[n] for n in names], np.uint32)
     gathered = np.asarray(multihost_utils.process_allgather(local))
     # gathered: (process_count, n_leaves). A shard-index group replicated

@@ -144,6 +144,16 @@ def test_save_load_weights_convenience(tmp_path):
     other.build((32, 32, 3))
     with pytest.raises(ValueError):
         other.load_weights(tmp_path / "w.h5")
+    # Same architecture, different width: same tree STRUCTURE, different
+    # leaf shapes — must fail with the offending path named, not load
+    # silently and blow up later inside the jitted step.
+    wider = dtpu.Model(dtpu.models.resnet(
+        18, 10, small_inputs=True, stage_blocks=(1, 1, 1, 1), width=16))
+    wider.compile(optimizer=dtpu.optim.SGD(0.05),
+                  loss="sparse_categorical_crossentropy")
+    wider.build((28, 28, 1))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        wider.load_weights(tmp_path / "w.h5")
 
 
 def test_save_load_weights_stateless_model(tmp_path):

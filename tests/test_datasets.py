@@ -1,0 +1,65 @@
+"""Dataset loader behaviors the benches depend on.
+
+The convergence bench's data-source honesty (reporting synthetic vs real)
+rests on these: cache discovery finds pre-seeded IDX files, and the
+network-guarded fetch NEVER raises on hermetic machines.
+"""
+
+import gzip
+import struct
+
+import numpy as np
+
+import distributed_tpu as dtpu
+from distributed_tpu.data import datasets
+
+
+def _write_idx(path, arr):
+    arr = np.ascontiguousarray(arr, np.uint8)
+    code = {1: 0x08}[arr.dtype.itemsize]
+    header = struct.pack(f">I{arr.ndim}I", (code << 8) | arr.ndim,
+                         *arr.shape)
+    with gzip.open(path, "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+def test_fetch_mnist_returns_none_without_network(tmp_path, monkeypatch):
+    """No egress (this CI) -> None quickly, no exception, no partial files
+    left behind."""
+    monkeypatch.setattr(datasets, "_MNIST_MIRRORS",
+                        ("http://127.0.0.1:1/nope/",))
+    # port 1 refuses instantly, so the egress probe and the (unreached)
+    # urlopen path are both exercised without a real network
+    out = dtpu.data.fetch_mnist(dest_dir=tmp_path / "cache", timeout=0.5)
+    assert out is None
+    leftover = list((tmp_path / "cache").glob("*")) if (
+        tmp_path / "cache").exists() else []
+    assert leftover == []
+
+
+def test_fetch_mnist_short_circuits_on_complete_cache(tmp_path):
+    d = tmp_path / "mnist"
+    d.mkdir()
+    for fname in datasets._MNIST_FILES:
+        shape = datasets._MNIST_SHAPES[fname]
+        _write_idx(d / fname, np.zeros(shape, np.uint8))
+    assert dtpu.data.fetch_mnist(dest_dir=d) == d
+
+
+def test_load_mnist_finds_preseeded_idx_cache(tmp_path, monkeypatch):
+    """The provisioning recipe (docs/PROVISIONING.md): IDX .gz files under
+    $DTPU_DATA_DIR/mnist are found and parsed, bypassing synthetic."""
+    d = tmp_path / "mnist"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 255, (64, 28, 28), dtype=np.uint8)
+    y = rng.integers(0, 10, (64,), dtype=np.uint8)
+    _write_idx(d / "train-images-idx3-ubyte.gz", x)
+    _write_idx(d / "train-labels-idx1-ubyte.gz", y)
+    # Patch the search path wholesale: a real mnist.npz in this user's
+    # ~/.keras/datasets would otherwise shadow the fixture.
+    monkeypatch.setattr(datasets, "_search_dirs", lambda dd: [tmp_path])
+    got_x, got_y = dtpu.data.load_mnist("train", synthetic_ok=False,
+                                        normalize=False)
+    np.testing.assert_array_equal(got_x[..., 0], x)
+    np.testing.assert_array_equal(got_y, y.astype(np.int32))

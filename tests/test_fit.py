@@ -83,6 +83,20 @@ def test_validation_data():
     assert "val_loss" in hist.history and "val_accuracy" in hist.history
 
 
+def test_progress_bar_at_verbose_1(capsys):
+    """verbose=1 shows the per-step progress line (the reference's Keras
+    bar, /root/reference/README.md:309-311); on a non-tty stream the final
+    step always prints. verbose=2 is epoch-lines only."""
+    x, y = small_data(128)
+    model = make_model()
+    model.fit(x, y, batch_size=64, epochs=1, verbose=1, seed=0)
+    out = capsys.readouterr().out
+    assert "2/2" in out and "ETA" in out
+    model2 = make_model()
+    model2.fit(x, y, batch_size=64, epochs=1, verbose=2, seed=0)
+    assert "ETA" not in capsys.readouterr().out
+
+
 def test_uncompiled_fit_raises():
     model = dtpu.Model(dtpu.models.mnist_cnn())
     x, y = small_data(n=64)

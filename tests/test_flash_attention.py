@@ -75,6 +75,17 @@ def test_incompatible_blocks_are_repaired():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
 
 
+def test_default_blocks_midsize_sequences():
+    """Default block sizes on 512 <= T < 1024 (where flash='auto' kicks in):
+    block_k is clamped to the q-rounded length so padded work stays within
+    one q-block, and padding must not leak into outputs."""
+    for t in (513, 600):
+        q, k, v = _qkv((1, t, 1, 32))
+        out = flash_attention(q, k, v, causal=True)  # default blocks
+        ref = dense_attention(q, k, v, True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
 def test_bf16_inputs():
     q, k, v = _qkv((2, 128, 2, 32), jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
