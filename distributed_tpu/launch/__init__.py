@@ -4,6 +4,7 @@ from .core import (
     WorkerResult,
     launch_local,
     report_result,
+    run_with_restart,
 )
 
 __all__ = [
@@ -12,4 +13,5 @@ __all__ = [
     "WorkerResult",
     "launch_local",
     "report_result",
+    "run_with_restart",
 ]

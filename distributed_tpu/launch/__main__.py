@@ -30,6 +30,11 @@ def main(argv=None):
     ap.add_argument("--python", type=str, default=sys.executable)
     ap.add_argument("--results-json", type=str, default=None,
                     help="write the worker result rows to this file")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="on worker failure, relaunch the whole gang up to "
+                         "N times; pair with ModelCheckpoint(restore=True) "
+                         "in the script so relaunches resume from the "
+                         "latest checkpoint")
     ap.add_argument("script", type=str)
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -38,11 +43,16 @@ def main(argv=None):
     if args.hosts:
         kw = {"port": args.base_port} if args.base_port else {}
         launcher = core.SSHLauncher(args.hosts.split(","), **kw)
-        results = launcher.run(worker_argv, timeout=args.timeout)
+        results = core.run_with_restart(
+            launcher, worker_argv, max_restarts=args.max_restarts,
+            timeout=args.timeout,
+        )
     else:
         n = args.num_workers or 1
-        results = core.LocalLauncher().run(
-            worker_argv, n, timeout=args.timeout, base_port=args.base_port
+        results = core.run_with_restart(
+            core.LocalLauncher(), worker_argv, n,
+            max_restarts=args.max_restarts,
+            timeout=args.timeout, base_port=args.base_port,
         )
 
     rows = [

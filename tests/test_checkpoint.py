@@ -2,6 +2,7 @@
 invariant (the capability gap the reference documents at README.md:400:
 no resume — 'Workers will need to restart training if any fails')."""
 
+import pytest
 import jax
 import numpy as np
 
@@ -37,6 +38,7 @@ def test_npz_save_load_with_meta(tmp_path):
     assert tree_equal(tree, back)
 
 
+@pytest.mark.smoke
 def test_resume_matches_uninterrupted_run(tmp_path):
     """Train 6 steps straight vs train 3 + checkpoint + restore + 3 more:
     final params must be bit-identical (momentum state and data cursor both

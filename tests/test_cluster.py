@@ -6,6 +6,7 @@ import pytest
 from distributed_tpu.cluster import ClusterSpec, config, from_barrier, net
 
 
+@pytest.mark.smoke
 def test_spec_json_roundtrip():
     spec = ClusterSpec(workers=["a:1", "b:2", "c:3"], index=2)
     again = ClusterSpec.from_json(spec.to_json())

@@ -6,6 +6,7 @@ network-guarded fetch NEVER raises on hermetic machines.
 """
 
 import gzip
+import pytest
 import struct
 
 import numpy as np
@@ -23,6 +24,7 @@ def _write_idx(path, arr):
         f.write(header + arr.tobytes())
 
 
+@pytest.mark.smoke
 def test_fetch_mnist_returns_none_without_network(tmp_path, monkeypatch):
     """No egress (this CI) -> None quickly, no exception, no partial files
     left behind."""

@@ -56,6 +56,7 @@ def test_params_replicated_and_batch_sharded(devices):
     assert shards[0].data.shape == (8, 28, 28, 1)
 
 
+@pytest.mark.smoke
 def test_replicas_bit_identical_after_training(devices):
     x, y = small_data()
     strategy = dtpu.DataParallel()

@@ -23,6 +23,7 @@ def make_model():
     return m
 
 
+@pytest.mark.smoke
 def test_fit_decreases_loss_and_returns_history():
     x, y = small_data()
     model = make_model()

@@ -86,6 +86,7 @@ def test_default_blocks_midsize_sequences():
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
 
 
+@pytest.mark.smoke
 def test_bf16_inputs():
     q, k, v = _qkv((2, 128, 2, 32), jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)

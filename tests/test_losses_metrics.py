@@ -1,3 +1,4 @@
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -5,6 +6,7 @@ import numpy as np
 from distributed_tpu.ops import losses, metrics
 
 
+@pytest.mark.smoke
 def test_sparse_cce_matches_manual():
     logits = jnp.array([[2.0, 1.0, 0.0], [0.0, 0.0, 5.0]])
     labels = jnp.array([0, 2])

@@ -58,6 +58,7 @@ def test_pooling():
     assert y[0, 0, 0, 0] == 2.5
 
 
+@pytest.mark.smoke
 def test_batchnorm_train_vs_eval():
     bn = nn.BatchNorm(momentum=0.5)
     params, state, _ = bn.init(jax.random.PRNGKey(0), (8,))

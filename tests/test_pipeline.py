@@ -26,6 +26,7 @@ NATIVE_PARAMS = [
 
 @pytest.mark.parametrize("use_native", NATIVE_PARAMS)
 class TestPipeline:
+    @pytest.mark.smoke
     def test_shapes_dtypes_normalization(self, use_native):
         x, y = _dataset()
         p = Pipeline(x, y, 16, shuffle=False, use_native=use_native)

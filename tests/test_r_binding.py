@@ -27,6 +27,7 @@ class TestStructure:
         assert (PKG / "NAMESPACE").is_file()
         assert _r_sources(), "no R sources"
 
+    @pytest.mark.smoke
     def test_exports_are_defined(self):
         # Every export(<name>) in NAMESPACE has a definition in R/ sources.
         ns = (PKG / "NAMESPACE").read_text()

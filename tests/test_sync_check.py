@@ -31,6 +31,7 @@ def test_healthy_dp_run_passes_and_reports_zero_drift():
     assert all(v == 0.0 for v in drift.values()), drift
 
 
+@pytest.mark.smoke
 def test_diverged_replica_is_caught():
     m = _dp_model()
     # Corrupt one device's replica of one parameter.

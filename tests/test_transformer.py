@@ -35,6 +35,7 @@ def _copy_task(n, t, seed=0):
 
 
 class TestAttention:
+    @pytest.mark.smoke
     def test_forward_shape(self):
         layer = nn.MultiHeadAttention(4)
         params, state, out = layer.init(jax.random.PRNGKey(0), (10, 32))
