@@ -72,9 +72,19 @@ class PipelinedBlocks(Layer):
     ``lax.scan`` — identical numerics, which is what the parity tests assert.
     """
 
-    # The scanned/piped stack has no per-block cache threading; generation
-    # from a pipelined LM must fail loudly, not silently drop attention
-    # history (Layer.decode's default would run the inner MHA uncached).
+    # Incremental decode IS supported (same stacked-cache recipe as
+    # ScannedBlocks): caches are stacked with a leading (S, ...) stage dim
+    # like the params, and decode() scans the template block's cached
+    # one-token step over them — generation is inherently sequential
+    # through the stack, so there is no microbatch schedule to run. On a
+    # live 'pipe' mesh this is correct but NOT memory-sharded: GSPMD
+    # all-gathers the pipe-sharded stage params (and cache) for the scan,
+    # so every device temporarily holds the full stack during generate().
+    # Fine for single-host serving of models that fit one device; a model
+    # that needs PP *because* its weights exceed one device's HBM needs a
+    # shard_map decode with activation hops instead (future work).
+    # decode_safe stays False so a template whose own decode would silently
+    # be wrong still fails loudly inside the scan body.
     decode_safe = False
 
     def __init__(
@@ -229,3 +239,18 @@ class PipelinedBlocks(Layer):
             **_CHECK_KWARGS,
         )(*args)
         return out, {}
+
+    # ---------------------------------------------------- incremental decode
+    def init_cache(self, params, batch, max_len, dtype):
+        from .scan import stacked_init_cache
+
+        return stacked_init_cache(
+            self.block, self.num_blocks, params["blocks"], batch, max_len,
+            dtype,
+        )
+
+    def decode(self, params, state, cache, x, *, pos):
+        from .scan import stacked_decode
+
+        return stacked_decode(self.block, params["blocks"], {}, cache, x,
+                              pos=pos)

@@ -95,6 +95,44 @@ def scan_stacked(block, stacked_p, stacked_s, x, *, train, rngs):
     return lax.scan(body, x, (stacked_p, stacked_s, rngs))
 
 
+def stacked_init_cache(block, num_blocks, stacked_p, batch, max_len, dtype):
+    """Stacked (S, ...) decode caches for a block stack — shared by
+    ScannedBlocks and PipelinedBlocks so the cache layout can't diverge.
+    Broadcasts the template's cache rather than allocating zeros: a layer
+    whose cache initializes non-zero must start every block's slice from
+    those values, exactly as the unrolled form would."""
+    p0 = jax.tree_util.tree_map(lambda l: l[0], stacked_p)
+    c0 = block.init_cache(p0, batch, max_len, dtype)
+    if not jax.tree_util.tree_leaves(c0):
+        return {}
+    return {
+        "blocks": jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l, (num_blocks,) + l.shape).copy(),
+            c0,
+        )
+    }
+
+
+def stacked_decode(block, stacked_p, stacked_s, cache, x, *, pos):
+    """One-token step through a block stack: scan the template's cached
+    decode over the stacked (params, state, cache), writing each block's
+    new KV rows back into its slice. Returns (y, new_cache_tree_or_cache).
+    Shared by ScannedBlocks and PipelinedBlocks (which passes an empty
+    state stack — its blocks are validated stateless at init)."""
+
+    def body(h, per_block):
+        p, s, c = per_block
+        y, new_c = block.decode(p, s, c, h, pos=pos)
+        return y.astype(h.dtype), new_c
+
+    out, new_cache = lax.scan(
+        body, x, (stacked_p, stacked_s, cache.get("blocks", {}))
+    )
+    if jax.tree_util.tree_leaves(new_cache):
+        return out, {"blocks": new_cache}
+    return out, cache
+
+
 class ScannedBlocks(Layer):
     """S structurally identical, shape-preserving blocks run as one scan.
 
@@ -185,41 +223,13 @@ class ScannedBlocks(Layer):
 
     # ---------------------------------------------------- incremental decode
     def init_cache(self, params, batch, max_len, dtype):
-        # Cache shapes depend only on one block's param shapes; build the
-        # template's cache once and allocate an (S, ...)-stacked zero tree.
-        p0 = jax.tree_util.tree_map(lambda l: l[0], params["blocks"])
-        c0 = self.block.init_cache(p0, batch, max_len, dtype)
-        if not jax.tree_util.tree_leaves(c0):
-            return {}
-        # Broadcast the template cache rather than allocating zeros: a
-        # layer whose cache initializes non-zero must start every block's
-        # slice from those values, exactly as the unrolled form would.
-        return {
-            "blocks": jax.tree_util.tree_map(
-                lambda l: jnp.broadcast_to(
-                    l, (self.num_blocks,) + l.shape
-                ).copy(),
-                c0,
-            )
-        }
+        return stacked_init_cache(
+            self.block, self.num_blocks, params["blocks"], batch, max_len,
+            dtype,
+        )
 
     def decode(self, params, state, cache, x, *, pos):
-        """One-token step through the whole stack: scan the template's
-        cached decode over the stacked (params, state, cache), writing each
-        block's new KV rows back into its slice of the stacked cache."""
-        block = self.block
-
-        def body(h, per_block):
-            p, s, c = per_block
-            y, new_c = block.decode(p, s, c, h, pos=pos)
-            return y.astype(h.dtype), new_c
-
-        out, new_cache = lax.scan(
-            body,
-            x,
-            (params["blocks"], state.get("blocks", {}),
-             cache.get("blocks", {})),
+        return stacked_decode(
+            self.block, params["blocks"], state.get("blocks", {}), cache, x,
+            pos=pos,
         )
-        if jax.tree_util.tree_leaves(new_cache):
-            return out, {"blocks": new_cache}
-        return out, cache

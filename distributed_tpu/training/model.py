@@ -628,7 +628,8 @@ class Model:
         one ``lax.scan`` inside one jit: the prompt is teacher-forced through
         the same cached step the sampled tokens use, so there is exactly one
         compile and O(T) attention per step (nn layers' ``decode``/
-        ``init_cache``; not supported for pipelined stacks).
+        ``init_cache``; scanned AND pipelined stacks decode through stacked
+        per-block caches).
 
         The reference has no generation surface at all (its only model is a
         classifier CNN, /root/reference/README.md:58-68); this is part of

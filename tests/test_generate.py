@@ -132,12 +132,55 @@ def test_noncausal_decode_raises():
         mha.decode(params, {}, cache, jnp.zeros((1, 1, 16)), pos=0)
 
 
-def test_generate_pipelined_lm_raises():
-    model = dtpu.Model(_lm(pipeline=True))
-    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
-    model.build((16,))
-    with pytest.raises(NotImplementedError, match="decode"):
-        model.generate(np.array([[1, 2]], np.int32), 4)
+def _restack_unrolled_into(pu, num_layers, container):
+    """Map the unrolled LM param tree (flat residual_{2i}/residual_{2i+1})
+    into a stacked container layout ({container: {"blocks": ...}})."""
+    def name(i):
+        return "residual" if i == 0 else f"residual_{i}"
+
+    stacked = {}
+    for slot, off in (("residual", 0), ("residual_1", 1)):
+        per = [pu[name(2 * i + off)] for i in range(num_layers)]
+        stacked[slot] = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *per)
+    ps = {k: v for k, v in pu.items() if not k.startswith("residual")}
+    ps[container] = {"blocks": stacked}
+    return ps
+
+
+def test_generate_pipelined_matches_unrolled(devices):
+    """PP-trained LMs can generate: greedy decode through the stacked stage
+    caches equals the unrolled model's, both on a single device and with
+    the stage stack sharded over a live 'pipe' mesh axis."""
+    L = 2
+    kw = dict(layers=L, d=32, heads=4, max_len=32)
+    mu = dtpu.Model(_lm(vocab=64, **kw))
+    mu.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    mu.build((16,), seed=7)
+
+    prompt = np.array([[5, 9, 2, 11], [1, 1, 3, 60]], np.int32)
+    want = mu.generate(prompt, 8, temperature=0.0)
+
+    mp = dtpu.Model(_lm(vocab=64, pipeline=True, **kw))
+    mp.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    mp.build((16,), seed=0)
+    mp.params = _restack_unrolled_into(mu.params, L, "pipelined_blocks")
+    np.testing.assert_array_equal(want, mp.generate(prompt, 8,
+                                                    temperature=0.0))
+
+    strategy = dtpu.DataPipelineParallel(devices=devices,
+                                         pipeline_parallel=2)
+    with strategy.scope():
+        ms = dtpu.Model(_lm(vocab=64, pipeline=True, **kw))
+        ms.compile(optimizer="adam",
+                   loss="sparse_categorical_crossentropy")
+        ms.build((16,), seed=0)
+    ms.params = ms.strategy.put_params(
+        _restack_unrolled_into(mu.params, L, "pipelined_blocks"),
+        ms.module.sharding_hints(),
+    )
+    np.testing.assert_array_equal(want, ms.generate(prompt, 8,
+                                                    temperature=0.0))
 
 
 def test_generate_under_tensor_parallel_matches_single_device(devices):

@@ -43,6 +43,51 @@ class TestRingAttentionOp:
         ref = _dense_reference(q, k, v, causal)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.parametrize("n_seq", [2, 4, 8])
+    def test_zigzag_matches_naive_and_dense(self, devices, n_seq):
+        """The balanced causal schedule is numerically a re-association of
+        the same softmax — both schedules must match dense, for even AND
+        odd chunk-pair counts."""
+        mesh = dtpu.make_mesh({"seq": n_seq}, devices=devices[:n_seq])
+        q, k, v = _qkv(t=16)
+        ref = _dense_reference(q, k, v, True)
+        for schedule in ("zigzag", "naive"):
+            out = ring_attention(q, k, v, mesh=mesh, causal=True,
+                                 schedule=schedule)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5,
+                                       err_msg=schedule)
+
+    def test_zigzag_gradients_match_naive(self, devices):
+        mesh = dtpu.make_mesh({"seq": 4}, devices=devices[:4])
+        q, k, v = _qkv(t=16)
+
+        def loss(schedule):
+            def f(q, k, v):
+                return jnp.sum(
+                    ring_attention(q, k, v, mesh=mesh, causal=True,
+                                   schedule=schedule) ** 2
+                )
+            return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        for a, b in zip(loss("zigzag"), loss("naive")):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_zigzag_requires_causal_and_even_shard(self, devices):
+        mesh = dtpu.make_mesh({"seq": 8}, devices=devices)
+        q, k, v = _qkv(t=16)
+        with pytest.raises(ValueError, match="zigzag"):
+            ring_attention(q, k, v, mesh=mesh, causal=False,
+                           schedule="zigzag")
+        q2, k2, v2 = _qkv(t=8)  # per-shard length 1: cannot split in half
+        with pytest.raises(ValueError, match="zigzag"):
+            ring_attention(q2, k2, v2, mesh=mesh, causal=True,
+                           schedule="zigzag")
+        # auto silently falls back to naive for the same inputs.
+        out = ring_attention(q2, k2, v2, mesh=mesh, causal=True)
+        np.testing.assert_allclose(
+            out, _dense_reference(q2, k2, v2, True), rtol=1e-5, atol=1e-5
+        )
+
     def test_data_x_seq_mesh(self, devices):
         mesh = dtpu.make_mesh({"data": 2, "seq": 4}, devices=devices)
         q, k, v = _qkv(b=4, t=32, seed=1)
