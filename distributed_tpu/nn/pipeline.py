@@ -57,6 +57,26 @@ else:  # pragma: no cover
 del _sig
 
 
+def _live_pipe_mesh(strategy):
+    """(mesh, pipe_axis) when the ambient strategy carries a >1-rank pipe
+    axis, else (None, None) — the single dispatch used by BOTH the training
+    schedule and the ring decode, so they cannot diverge."""
+    pipe_axis = getattr(strategy, "pipe_axis", None)
+    mesh = getattr(strategy, "mesh", None)
+    if (
+        pipe_axis is None
+        or mesh is None
+        or pipe_axis not in mesh.axis_names
+        or int(mesh.shape[pipe_axis]) == 1
+    ):
+        return None, None
+    return mesh, pipe_axis
+
+
+def _stage_spec(pipe_axis):
+    return lambda a: PartitionSpec(pipe_axis, *((None,) * (a.ndim - 1)))
+
+
 class PipelinedBlocks(Layer):
     """S structurally identical shape-preserving blocks, stacked for
     pipeline parallelism.
@@ -74,15 +94,14 @@ class PipelinedBlocks(Layer):
 
     # Incremental decode IS supported (same stacked-cache recipe as
     # ScannedBlocks): caches are stacked with a leading (S, ...) stage dim
-    # like the params, and decode() scans the template block's cached
-    # one-token step over them — generation is inherently sequential
-    # through the stack, so there is no microbatch schedule to run. On a
-    # live 'pipe' mesh this is correct but NOT memory-sharded: GSPMD
-    # all-gathers the pipe-sharded stage params (and cache) for the scan,
-    # so every device temporarily holds the full stack during generate().
-    # Fine for single-host serving of models that fit one device; a model
-    # that needs PP *because* its weights exceed one device's HBM needs a
-    # shard_map decode with activation hops instead (future work).
+    # like the params. Off a pipe mesh, decode() scans the template
+    # block's cached one-token step over the full stack. On a LIVE 'pipe'
+    # mesh it runs the memory-sharded ring decode instead: each rank keeps
+    # only its (S/n)-block param/cache slices resident and the activation
+    # hops rank-to-rank via ppermute (generation is inherently sequential
+    # through the stack, so every rank executing each hop costs the same
+    # total block-compute as the gather-everything form — but no rank ever
+    # materializes the full weight stack, which is the reason PP exists).
     # decode_safe stays False so a template whose own decode would silently
     # be wrong still fails loudly inside the scan body.
     decode_safe = False
@@ -147,14 +166,8 @@ class PipelinedBlocks(Layer):
         stacked = params["blocks"]
         rngs = self._stage_rngs(rng)
         strategy = current_strategy()
-        pipe_axis = getattr(strategy, "pipe_axis", None)
-        mesh = getattr(strategy, "mesh", None)
-        if (
-            pipe_axis is None
-            or mesh is None
-            or pipe_axis not in mesh.axis_names
-            or int(mesh.shape[pipe_axis]) == 1
-        ):
+        mesh, pipe_axis = _live_pipe_mesh(strategy)
+        if mesh is None:
             return self._scan_blocks(stacked, x, train=train, rngs=rngs), {}
 
         n = int(mesh.shape[pipe_axis])
@@ -186,10 +199,7 @@ class PipelinedBlocks(Layer):
         feat_none = (None,) * (x.ndim - 1)
         rows = row_axes if len(row_axes) > 1 else row_axes[0]
         x_spec = PartitionSpec(rows, *feat_none)
-        p_specs = jax.tree_util.tree_map(
-            lambda a: PartitionSpec(pipe_axis, *((None,) * (a.ndim - 1))),
-            stacked,
-        )
+        p_specs = jax.tree_util.tree_map(_stage_spec(pipe_axis), stacked)
         in_specs = [p_specs, x_spec]
         args = [stacked, x]
         if rngs is not None:
@@ -250,7 +260,63 @@ class PipelinedBlocks(Layer):
         )
 
     def decode(self, params, state, cache, x, *, pos):
+        from ..parallel.strategy import current_strategy
         from .scan import stacked_decode
 
-        return stacked_decode(self.block, params["blocks"], {}, cache, x,
-                              pos=pos)
+        mesh, pipe_axis = _live_pipe_mesh(current_strategy())
+        stacked = params["blocks"]
+        if mesh is not None and self.num_blocks % int(mesh.shape[pipe_axis]):
+            # Same loud failure as apply(): silently taking the gather-
+            # everything path would materialize the full stack on every
+            # device — the opposite of what a pipe mesh promises.
+            raise ValueError(
+                f"{self.num_blocks} blocks not divisible by "
+                f"{pipe_axis}={int(mesh.shape[pipe_axis])} stages"
+            )
+        if mesh is None or not jax.tree_util.tree_leaves(cache):
+            return stacked_decode(self.block, stacked, {}, cache, x, pos=pos)
+
+        # Memory-sharded ring decode (class comment): every rank holds its
+        # local stage slice; all ranks start from the replicated token
+        # activation, and after hop i rank i holds the TRUE activation —
+        # so rank r's cache write is kept only at iteration r, and after n
+        # hops the final output has wrapped around to rank 0.
+        n = int(mesh.shape[pipe_axis])
+        block = self.block
+
+        p_specs = jax.tree_util.tree_map(_stage_spec(pipe_axis), stacked)
+        c_specs = jax.tree_util.tree_map(
+            _stage_spec(pipe_axis), cache["blocks"]
+        )
+        x_spec = PartitionSpec(*((None,) * x.ndim))
+
+        def local_fn(p_local, c_local, h, pos):
+            my = lax.axis_index(pipe_axis)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+
+            def hop(carry, i):
+                h, c = carry
+                y, new_c = stacked_decode(
+                    block, p_local, {}, {"blocks": c}, h, pos=pos
+                )
+                new_c = new_c["blocks"]
+                keep = i == my
+                c = jax.tree_util.tree_map(
+                    lambda nl, ol: jnp.where(keep, nl, ol), new_c, c
+                )
+                return (lax.ppermute(y, pipe_axis, perm), c), None
+
+            (h, c_local), _ = lax.scan(hop, (h, c_local), jnp.arange(n))
+            out = lax.psum(
+                jnp.where(my == 0, h, jnp.zeros_like(h)), pipe_axis
+            )
+            return out, c_local
+
+        out, new_blocks = shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(p_specs, c_specs, x_spec, PartitionSpec()),
+            out_specs=(x_spec, c_specs),
+            **_CHECK_KWARGS,
+        )(stacked, cache["blocks"], x, jnp.asarray(pos))
+        return out, {"blocks": new_blocks}

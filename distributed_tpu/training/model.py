@@ -689,11 +689,14 @@ class Model:
         if run is None:
             while len(self._generate_fns) >= self._GENERATE_CACHE_MAX:
                 self._generate_fns.pop(next(iter(self._generate_fns)))
-            run = jax.jit(
+            # _scoped: decode paths read current_strategy() at trace time
+            # (PipelinedBlocks picks its memory-sharded ring decode from
+            # the ambient pipe mesh, exactly as apply() picks its schedule).
+            run = self._scoped(jax.jit(
                 functools.partial(
                     _generate_scan, module, bucket, temperature, top_k
                 )
-            )
+            ))
         self._generate_fns[sig] = run  # (re-)insert as most recent
 
         toks = np.asarray(

@@ -183,6 +183,34 @@ def test_generate_pipelined_matches_unrolled(devices):
                                                     temperature=0.0))
 
 
+def test_pipelined_decode_is_memory_sharded(devices):
+    """On a live pipe mesh, decode must run the ring schedule — stage
+    params/caches stay resident per rank (no all-gather of the stack) and
+    the activation hops via collective-permute."""
+    strategy = dtpu.DataPipelineParallel(devices=devices,
+                                         pipeline_parallel=2)
+    with strategy.scope():
+        m = dtpu.Model(_lm(vocab=64, layers=2, d=32, heads=4, max_len=32,
+                           pipeline=True))
+        m.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+        m.build((16,), seed=0)
+    module, params, state = m.module, m.params, m.state
+    cache = module.init_cache(params, 1, 16, jnp.float32)
+
+    def step(p, c, x):
+        with strategy.scope():
+            return module.decode(p, state, c, x, pos=3)
+
+    hlo = (
+        jax.jit(step)
+        .lower(params, cache, jnp.zeros((1, 1), jnp.int32))
+        .compile()
+        .as_text()
+    )
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo
+
+
 def test_generate_under_tensor_parallel_matches_single_device(devices):
     """Generation must work with Megatron-sharded params and produce the
     same greedy tokens as the unsharded model."""
