@@ -81,7 +81,13 @@ def unflatten_tree(flat: Dict[str, np.ndarray]):
         if not isinstance(node, dict):
             return node
         if node and all(re.fullmatch(r"#\d+", k) for k in node):
-            return tuple(fix(node[f"#{i}"]) for i in range(len(node)))
+            # Present indices in numeric order, NOT range(len): tuple
+            # elements that flatten to nothing (empty dicts — e.g. optax
+            # inject_hyperparams' hyperparams_states, EmptyState) leave
+            # gaps. Restore grafts leaves onto a freshly-init'd structure
+            # by order, so skipping the empties is exactly right.
+            idxs = sorted(int(k[1:]) for k in node)
+            return tuple(fix(node[f"#{i}"]) for i in idxs)
         return {k: fix(v) for k, v in node.items()}
 
     return fix(root)

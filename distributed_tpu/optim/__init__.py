@@ -3,6 +3,15 @@
 Parity target: ``optimizer_sgd(lr = 0.001)`` / ``tf.keras.optimizers.SGD``
 (/root/reference/README.md:71, 301). Optimizer state is an ordinary pytree, so
 it replicates/shards with the same ``NamedSharding`` rules as the parameters.
+
+Named constructors build through ``optax.inject_hyperparams``, which lifts
+the numeric hyperparameters (learning rate, momentum, ...) into the
+optimizer STATE instead of baking them into the jitted update — so
+``Model.set_learning_rate`` (and the ``LearningRateScheduler`` /
+``ReduceLROnPlateau`` callbacks) can change them between steps without a
+recompile, and a checkpointed run resumes with the learning rate it was
+actually using. Schedules still work: a callable learning_rate is
+re-evaluated against the step count inside the update, as before.
 """
 
 from __future__ import annotations
@@ -12,34 +21,89 @@ import optax
 
 def SGD(learning_rate: float = 0.001, momentum: float = 0.0, nesterov: bool = False):
     if momentum:
-        return optax.sgd(learning_rate, momentum=momentum, nesterov=nesterov)
-    return optax.sgd(learning_rate)
+        return optax.inject_hyperparams(optax.sgd)(
+            learning_rate, momentum=momentum, nesterov=nesterov
+        )
+    return optax.inject_hyperparams(optax.sgd)(learning_rate)
 
 
 def Adam(learning_rate: float = 0.001, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
-    return optax.adam(learning_rate, b1=b1, b2=b2, eps=eps)
+    return optax.inject_hyperparams(optax.adam)(
+        learning_rate, b1=b1, b2=b2, eps=eps
+    )
 
 
 def AdamW(learning_rate: float = 0.001, weight_decay: float = 0.01, b1=0.9, b2=0.999):
-    return optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay)
+    return optax.inject_hyperparams(optax.adamw)(
+        learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
+    )
 
 
 def RMSprop(learning_rate: float = 0.001, decay: float = 0.9,
             momentum: float = 0.0, eps: float = 1e-7):
-    return optax.rmsprop(learning_rate, decay=decay, momentum=momentum,
-                         eps=eps)
+    return optax.inject_hyperparams(optax.rmsprop)(
+        learning_rate, decay=decay, momentum=momentum, eps=eps
+    )
 
 
 def Adagrad(learning_rate: float = 0.001, eps: float = 1e-7):
-    return optax.adagrad(learning_rate, eps=eps)
+    return optax.inject_hyperparams(optax.adagrad)(learning_rate, eps=eps)
 
 
 def Lamb(learning_rate: float = 0.001, weight_decay: float = 0.0,
          b1: float = 0.9, b2: float = 0.999):
     """Layer-wise adaptive large-batch optimizer — the standard choice for
     the data-parallel global-batch scaling this framework's mesh enables."""
-    return optax.lamb(learning_rate, b1=b1, b2=b2,
-                      weight_decay=weight_decay)
+    return optax.inject_hyperparams(optax.lamb)(
+        learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
+    )
+
+
+def _tree_get(opt_state, name: str):
+    """optax.tree_utils.tree_get with this module's failure semantics:
+    a missing hyperparameter (raw optax transform) and a schedule-driven
+    one (tree_get's 'multiple values' — the schedule's wrapped state also
+    carries the name, and re-evaluates over whatever we write) both raise
+    a KeyError that says what to do instead."""
+    import optax.tree_utils as otu
+
+    try:
+        value = otu.tree_get(opt_state, name)
+    except KeyError as e:
+        raise KeyError(
+            f"hyperparameter {name!r} is schedule-driven in this optimizer "
+            "state — a per-step schedule recomputes it inside the update, "
+            "so runtime mutation would be silently overwritten. Mutate the "
+            "schedule (recompile) or use a constant hyperparameter."
+        ) from e
+    if value is None:
+        raise KeyError(
+            f"optimizer state carries no injectable hyperparameter "
+            f"{name!r} — build the optimizer via dtpu.optim names/"
+            "constructors (optax.inject_hyperparams) to make it mutable"
+        )
+    return value
+
+
+def set_hyperparam(opt_state, name: str, value):
+    """Return ``opt_state`` with injected hyperparameter ``name`` replaced
+    (e.g. 'learning_rate'), searching through chained/nested states.
+    Raises KeyError for raw optax transforms (nothing injected) and for
+    schedule-driven hyperparameters (mutation would be a silent no-op)."""
+    import jax.numpy as jnp
+    import optax.tree_utils as otu
+
+    current = _tree_get(opt_state, name)
+    return otu.tree_set(
+        opt_state,
+        **{name: jnp.asarray(value, getattr(current, "dtype", None))},
+    )
+
+
+def get_hyperparam(opt_state, name: str):
+    """Read an injected hyperparameter from ``opt_state`` (see
+    ``set_hyperparam``)."""
+    return _tree_get(opt_state, name)
 
 
 def sgd_with_cosine(learning_rate: float, steps: int, warmup: int = 0, momentum: float = 0.9):

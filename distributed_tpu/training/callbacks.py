@@ -194,6 +194,138 @@ class CSVLogger(Callback):
             f.write(",".join(row) + "\n")
 
 
+class LearningRateScheduler(Callback):
+    """Keras-shaped per-epoch LR schedule: ``schedule(epoch)`` or
+    ``schedule(epoch, current_lr)`` -> new learning rate, applied through
+    ``Model.set_learning_rate`` (no recompile — named optimizers carry
+    their hyperparameters in the optimizer state). For per-STEP schedules
+    prefer the jit-native ``optim.cosine_schedule``-style callables, which
+    run inside the compiled update."""
+
+    def __init__(self, schedule, verbose: int = 0):
+        self.schedule = schedule
+        self.verbose = int(verbose)
+
+    def on_epoch_begin(self, model, epoch):
+        try:
+            lr = self.schedule(epoch, model.get_learning_rate())
+        except TypeError:
+            lr = self.schedule(epoch)
+        model.set_learning_rate(float(lr))
+        if self.verbose and jax.process_index() == 0:
+            dlog.info(f"LearningRateScheduler: epoch {epoch + 1} lr={lr:g}")
+
+
+class ReduceLROnPlateau(Callback):
+    """Multiply the LR by ``factor`` after ``patience`` epochs without
+    ``monitor`` improving by at least ``min_delta``. Decisions come from
+    epoch logs that are identical on every process (all-reduced metrics),
+    so an SPMD gang reduces in lockstep. The applied LR lives in the
+    optimizer state and therefore checkpoints/resumes with the run; the
+    plateau counters are process-local and reset on restart (match Keras)."""
+
+    def __init__(self, monitor: str = "loss", *, factor: float = 0.5,
+                 patience: int = 3, min_delta: float = 1e-4,
+                 min_lr: float = 0.0, cooldown: int = 0, verbose: int = 0):
+        if not 0.0 < factor < 1.0:
+            raise ValueError(f"factor must be in (0, 1), got {factor}")
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.min_delta = float(min_delta)
+        self.min_lr = float(min_lr)
+        self.cooldown = int(cooldown)
+        self.verbose = int(verbose)
+        self._best = math.inf
+        self._wait = 0
+        self._cooling = 0
+
+    def on_train_begin(self, model):
+        self._best = math.inf
+        self._wait = 0
+        self._cooling = 0
+
+    def on_epoch_end(self, model, epoch, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            dlog.warning(
+                f"ReduceLROnPlateau: metric {self.monitor!r} not in logs "
+                f"({sorted(logs)})"
+            )
+            return
+        # Higher-is-better metrics (accuracy-like) are negated so the
+        # plateau test is always minimization, like EarlyStopping.
+        sign = -1.0 if "acc" in self.monitor else 1.0
+        val = sign * float(cur)
+        if self._cooling > 0:
+            self._cooling -= 1
+            return
+        if val < self._best - self.min_delta:
+            self._best = val
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait < self.patience:
+            return
+        old = model.get_learning_rate()
+        new = max(old * self.factor, self.min_lr)
+        if new < old:
+            model.set_learning_rate(new)
+            if self.verbose and jax.process_index() == 0:
+                dlog.info(
+                    f"ReduceLROnPlateau: {self.monitor} plateaued "
+                    f"{self.patience} epochs; lr {old:g} -> {new:g}"
+                )
+        self._wait = 0
+        self._cooling = self.cooldown
+
+
+class TensorBoard(Callback):
+    """Write per-epoch scalars (loss, metrics, val_*) as TensorBoard event
+    files, chief-only. Uses the installed TensorFlow's summary writer
+    lazily — the framework itself has no TF dependency; constructing the
+    callback without TF raises with a clear message."""
+
+    def __init__(self, log_dir):
+        self.log_dir = str(log_dir)
+        self._writer = None
+        try:
+            import tensorflow as tf  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "callbacks.TensorBoard needs the tensorflow package for "
+                "event-file writing (CSVLogger is the dependency-free "
+                "alternative)"
+            ) from e
+
+    def on_train_begin(self, model):
+        if jax.process_index() != 0:
+            return
+        import tensorflow as tf
+
+        self._writer = tf.summary.create_file_writer(self.log_dir)
+
+    def on_epoch_end(self, model, epoch, logs):
+        if self._writer is None:
+            return
+        import tensorflow as tf
+
+        with self._writer.as_default():
+            for key, value in logs.items():
+                tf.summary.scalar(key, float(value), step=epoch)
+            try:
+                tf.summary.scalar("learning_rate",
+                                  model.get_learning_rate(), step=epoch)
+            except (KeyError, RuntimeError):
+                pass
+        self._writer.flush()
+
+    def on_train_end(self, model, history):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 class LambdaCallback(Callback):
     """Ad-hoc hooks without subclassing."""
 

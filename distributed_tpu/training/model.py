@@ -159,6 +159,30 @@ class Model:
             raise ValueError("Model not built")
         return tree_size(self.params)
 
+    # -------------------------------------------------------- learning rate
+    def set_learning_rate(self, lr: float):
+        """Change the learning rate of the CURRENT optimizer state without
+        recompiling (named optimizers carry their hyperparameters in the
+        state via optax.inject_hyperparams). Raises for raw optax
+        transforms that weren't built injectable."""
+        if self.opt_state is None:
+            raise RuntimeError("compile() and build() the model first")
+        self.opt_state = optim.set_hyperparam(
+            self.opt_state, "learning_rate", lr
+        )
+        return self
+
+    def get_learning_rate(self) -> float:
+        if self.opt_state is None:
+            raise RuntimeError("compile() and build() the model first")
+        return float(
+            np.asarray(
+                jax.device_get(
+                    optim.get_hyperparam(self.opt_state, "learning_rate")
+                )
+            )
+        )
+
     # ------------------------------------------------------------- train step
     def _get_train_step(self):
         if self._train_step is not None:

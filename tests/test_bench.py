@@ -44,7 +44,10 @@ def test_bench_resnet50_smoke():
         global_batch=8, image_size=32, warmup=1, measure=2, num_classes=10
     )
     assert out["value"] > 0
-    assert out["images_per_sec"] == pytest.approx(out["value"] * 8, rel=0.05)
+    # abs=0.06: images_per_sec is rounded to one decimal, which dominates
+    # the comparison when a loaded CPU runs the tiny smoke at <1 step/s.
+    assert out["images_per_sec"] == pytest.approx(out["value"] * 8,
+                                                  rel=0.05, abs=0.06)
     assert out["tflops"] > 0
     assert out["mfu"] is None  # CPU: unknown peak
 

@@ -198,3 +198,92 @@ class TestStepTimer:
         for _ in range(5):
             t.tick()
         assert t.steps_per_sec > 0
+
+
+def test_set_learning_rate_no_recompile():
+    """set_learning_rate mutates the injected hyperparams in the optimizer
+    STATE: lr=0 freezes params under the already-compiled step."""
+    import jax
+
+    x, y = _data()
+    m = _small_model()
+    m.fit(x, y.astype(np.int32), batch_size=64, epochs=1, verbose=0, seed=0)
+    assert abs(m.get_learning_rate() - 0.05) < 1e-9
+    m.set_learning_rate(0.0)
+    before = [np.asarray(l) for l in jax.tree_util.tree_leaves(m.params)]
+    m.fit(x, y.astype(np.int32), batch_size=64, epochs=1, verbose=0, seed=0)
+    after = [np.asarray(l) for l in jax.tree_util.tree_leaves(m.params)]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+
+
+def test_learning_rate_scheduler_applies_per_epoch():
+    from distributed_tpu.training.callbacks import LearningRateScheduler
+
+    x, y = _data()
+    m = _small_model()
+    seen = []
+    sched = LearningRateScheduler(lambda epoch: 0.1 / (epoch + 1))
+    probe = LambdaCallback(
+        on_epoch_begin=lambda model, epoch: seen.append(
+            round(model.get_learning_rate(), 6))
+    )
+    # scheduler runs before the probe (callback order in fit)
+    m.fit(x, y.astype(np.int32), batch_size=64, epochs=3, verbose=0,
+          seed=0, callbacks=[sched, probe])
+    assert seen == [0.1, 0.05, pytest.approx(0.1 / 3, abs=1e-6)]
+
+
+def test_reduce_lr_on_plateau_reduces():
+    from distributed_tpu.training.callbacks import ReduceLROnPlateau
+
+    m = _small_model()
+    m.build((28, 28, 1))
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           min_delta=1e-3)
+    cb.on_train_begin(m)
+    cb.on_epoch_end(m, 0, {"loss": 1.0})
+    cb.on_epoch_end(m, 1, {"loss": 1.0})   # wait 1
+    assert abs(m.get_learning_rate() - 0.05) < 1e-9
+    cb.on_epoch_end(m, 2, {"loss": 1.0})   # wait 2 -> reduce
+    assert abs(m.get_learning_rate() - 0.025) < 1e-9
+    # improvement resets the counter
+    cb.on_epoch_end(m, 3, {"loss": 0.5})
+    cb.on_epoch_end(m, 4, {"loss": 0.5})
+    assert abs(m.get_learning_rate() - 0.025) < 1e-9
+
+
+def test_raw_optax_transform_rejects_lr_mutation():
+    import optax
+
+    x, y = _data(64)
+    m = dtpu.Model(dtpu.models.mnist_cnn())
+    m.compile(optimizer=optax.sgd(0.05),
+              loss="sparse_categorical_crossentropy")
+    m.build((28, 28, 1))
+    with pytest.raises(KeyError, match="inject"):
+        m.set_learning_rate(0.01)
+
+
+def test_tensorboard_callback_writes_events(tmp_path):
+    from distributed_tpu.training.callbacks import TensorBoard
+
+    x, y = _data()
+    m = _small_model()
+    m.fit(x, y.astype(np.int32), batch_size=64, epochs=2, verbose=0,
+          seed=0, callbacks=[TensorBoard(tmp_path / "tb")])
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+
+
+def test_schedule_driven_lr_rejects_mutation():
+    """A per-step schedule recomputes the lr inside the update; runtime
+    mutation would silently be overwritten, so it must raise instead."""
+    x, y = _data(64)
+    m = dtpu.Model(dtpu.models.mnist_cnn())
+    m.compile(optimizer=dtpu.optim.SGD(
+        dtpu.optim.cosine_schedule(0.1, steps=10)),
+        loss="sparse_categorical_crossentropy")
+    m.build((28, 28, 1))
+    with pytest.raises(KeyError, match="schedule-driven"):
+        m.set_learning_rate(0.01)
