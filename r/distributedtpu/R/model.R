@@ -143,6 +143,46 @@ early_stopping_callback <- function(monitor = "loss", patience = 0L,
 #' @export
 csv_logger_callback <- function(path) dtpu()$callbacks$CSVLogger(path)
 
+#' Per-epoch learning-rate schedule: `schedule(epoch)` or
+#' `schedule(epoch, lr)` (0-based epoch) returns the new rate, applied
+#' without recompiling (named optimizers carry their hyperparameters in
+#' the optimizer state). The R closure is normalized to the two-argument
+#' form here: Python's arity fallback catches TypeError only, which a
+#' reticulate-wrapped R closure's "unused argument" error is not.
+#' @export
+learning_rate_scheduler_callback <- function(schedule, verbose = 0L) {
+  wrapped <- if (length(formals(schedule)) >= 2) {
+    schedule
+  } else {
+    function(epoch, lr) schedule(epoch)
+  }
+  dtpu()$callbacks$LearningRateScheduler(wrapped,
+                                         verbose = as.integer(verbose))
+}
+
+#' Multiply the learning rate by `factor` after `patience` epochs without
+#' `monitor` improving; mirrors keras::callback_reduce_lr_on_plateau.
+#' @export
+reduce_lr_on_plateau_callback <- function(monitor = "loss", factor = 0.5,
+                                          patience = 3L, min_delta = 1e-4,
+                                          min_lr = 0, cooldown = 0L,
+                                          verbose = 0L) {
+  dtpu()$callbacks$ReduceLROnPlateau(monitor = monitor,
+                                     factor = as.numeric(factor),
+                                     patience = as.integer(patience),
+                                     min_delta = as.numeric(min_delta),
+                                     min_lr = as.numeric(min_lr),
+                                     cooldown = as.integer(cooldown),
+                                     verbose = as.integer(verbose))
+}
+
+#' Chief-only per-epoch TensorBoard scalars (event files via the host's
+#' TensorFlow installation).
+#' @export
+tensorboard_callback <- function(log_dir) {
+  dtpu()$callbacks$TensorBoard(log_dir)
+}
+
 #' Keras-style weight round-trip (params AND BatchNorm running stats);
 #' writes npz instead of HDF5 when the path ends in .npz.
 #' @export

@@ -517,6 +517,40 @@ class RBinding:
     def csv_logger_callback(self, path):
         return self.dtpu().attr("callbacks").attr("CSVLogger")(path)
 
+    # model.R:153-161 — mirrors the R-side arity normalization: a
+    # one-formal closure is wrapped to the two-argument form before it
+    # crosses the bridge (reticulate surfaces R arity errors as
+    # RuntimeError, not the TypeError the Python fallback catches).
+    def learning_rate_scheduler_callback(self, schedule, verbose=r_int(0)):
+        import inspect
+
+        if len(inspect.signature(schedule).parameters) >= 2:
+            wrapped = schedule
+        else:
+            def wrapped(epoch, lr):
+                return schedule(epoch)
+        return self.dtpu().attr("callbacks").attr("LearningRateScheduler")(
+            wrapped, verbose=as_integer(verbose)
+        )
+
+    # model.R:166-177
+    def reduce_lr_on_plateau_callback(self, monitor=r_character("loss"),
+                                      factor=r_double(0.5),
+                                      patience=r_int(3),
+                                      min_delta=r_double(1e-4),
+                                      min_lr=r_double(0),
+                                      cooldown=r_int(0), verbose=r_int(0)):
+        return self.dtpu().attr("callbacks").attr("ReduceLROnPlateau")(
+            monitor=monitor, factor=as_numeric(factor),
+            patience=as_integer(patience), min_delta=as_numeric(min_delta),
+            min_lr=as_numeric(min_lr), cooldown=as_integer(cooldown),
+            verbose=as_integer(verbose),
+        )
+
+    # model.R:182-184
+    def tensorboard_callback(self, log_dir):
+        return self.dtpu().attr("callbacks").attr("TensorBoard")(log_dir)
+
     # strategy.R:8
     def single_device_strategy(self):
         return self.dtpu().attr("SingleDevice")()

@@ -49,6 +49,9 @@ MAPPING = {
     "model_checkpoint_callback": "model_checkpoint_callback",
     "early_stopping_callback": "early_stopping_callback",
     "csv_logger_callback": "csv_logger_callback",
+    "learning_rate_scheduler_callback": "learning_rate_scheduler_callback",
+    "reduce_lr_on_plateau_callback": "reduce_lr_on_plateau_callback",
+    "tensorboard_callback": "tensorboard_callback",
     "print.dtpu_history": None,  # pure R-side display, no dtpu() calls
     "single_device_strategy": "single_device_strategy",
     "data_parallel_strategy": "data_parallel_strategy",

@@ -269,6 +269,22 @@ def test_callbacks_constructed_from_r(rb, tmp_path):
                                       save_freq=r_double(5.0))
     assert cb._obj.save_freq == 5
 
+    # LR callbacks: schedule fn applies through set_learning_rate; plateau
+    # factor/patience marshal through as.numeric/as.integer; TensorBoard
+    # writes chief-only event files.
+    tb_dir = str(tmp_path / "tb")
+    cbs2 = RList([
+        rb.learning_rate_scheduler_callback(lambda epoch: 0.05 / (epoch + 1)),
+        rb.reduce_lr_on_plateau_callback(monitor=r_character("loss"),
+                                         factor=r_double(0.5),
+                                         patience=r_int(2)),
+        rb.tensorboard_callback(r_character(tb_dir)),
+    ])
+    rb.fit(model, x, y, batch_size=r_int(64), epochs=r_int(2),
+           steps_per_epoch=r_int(2), verbose=r_int(0), callbacks=cbs2)
+    assert abs(model._obj.get_learning_rate() - 0.025) < 1e-9
+    assert any("tfevents" in p.name for p in Path(tb_dir).iterdir())
+
 
 def test_resnet_and_cifar_constructors(rb):
     """The other two model constructors model.R exports; logical and integer
