@@ -749,7 +749,7 @@ def _dense_fallback(q, k, v, causal):
 
 def flash_attention(
     q, k, v, *, causal: bool = False,
-    block_q: int = 256, block_k: int = 1024,
+    block_q: int = 1024, block_k: int = 1024,
 ):
     """softmax(Q K^T / sqrt(d)) V without materializing the (T, T) scores.
 
@@ -773,6 +773,11 @@ def flash_attention(
         return _dense_fallback(q, k, v, causal)
     b, t, h, d = q.shape
     rt = _round_up(t, 8)
+    # float32 inputs double every VMEM-resident block: the bf16-swept
+    # block_q=1024 default exceeds the 16MB scoped-VMEM limit at T>=2048
+    # (Mosaic compile error), so clamp the q block for wide dtypes.
+    if jnp.dtype(q.dtype).itemsize >= 4:
+        block_q = min(block_q, 512)
     bq = min(block_q, rt)
     # Clamp block_k to the q-rounded sequence length: t_pad is a multiple of
     # max(bq, bk), so an unclamped default (1024) would pad mid-size

@@ -110,10 +110,11 @@ class TestTensorParallel:
         # unhinted params stay replicated
         emb = p["embedding"]["table"]
         assert emb.sharding.spec == PartitionSpec()
-        # optimizer state shards like the params (momentum mirrors kernel)
+        # optimizer state shards like the params (momentum mirrors kernel);
+        # named optimizers wrap the inner state in inject_hyperparams.
         model.compile(optimizer=dtpu.optim.SGD(0.1, momentum=0.9),
                       loss="sparse_categorical_crossentropy")
-        mom = model.opt_state[0].trace["residual"]["main"][
+        mom = model.opt_state.inner_state[0].trace["residual"]["main"][
             "multi_head_attention"]["wq"]
         assert mom.sharding.spec == PartitionSpec(None, "model")
 
