@@ -749,7 +749,7 @@ def _dense_fallback(q, k, v, causal):
 
 def flash_attention(
     q, k, v, *, causal: bool = False,
-    block_q: int = 1024, block_k: int = 1024,
+    block_q: Optional[int] = None, block_k: int = 1024,
 ):
     """softmax(Q K^T / sqrt(d)) V without materializing the (T, T) scores.
 
@@ -758,6 +758,11 @@ def flash_attention(
     On backends with neither a Mosaic lowering nor a test rationale for
     interpret mode (anything but TPU/CPU), falls back to dense XLA attention
     with a one-time warning.
+
+    ``block_q=None`` (default) resolves to the swept 1024, scoped-VMEM-
+    clamped to 512 for float32 inputs or T >= 2048 (see the comment at the
+    clamp). An EXPLICIT block_q is honored as passed — sweeps on chips
+    with different VMEM budgets must measure what they ask for.
     """
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):
@@ -773,11 +778,19 @@ def flash_attention(
         return _dense_fallback(q, k, v, causal)
     b, t, h, d = q.shape
     rt = _round_up(t, 8)
-    # float32 inputs double every VMEM-resident block: the bf16-swept
-    # block_q=1024 default exceeds the 16MB scoped-VMEM limit at T>=2048
-    # (Mosaic compile error), so clamp the q block for wide dtypes.
-    if jnp.dtype(q.dtype).itemsize >= 4:
-        block_q = min(block_q, 512)
+    if block_q is None:
+        # Swept default with scoped-VMEM clamps (16MB limit on v5e):
+        # - float32 inputs double every resident block (measured compile
+        #   failure at T>=2048 with 1024);
+        # - bf16 at long sequence: the full-model BACKWARD kernel's stack
+        #   (dq/dk/dv blocks + f32 stat rows spanning T) measured over the
+        #   limit at T=4096 with bq=1024; T in [2048, 4096) is unswept
+        #   borderline, so the clamp starts there conservatively. bq=512
+        #   still beats the old 256 default by ~11% at T=4096
+        #   (docs/PERF.md round-4 sweep).
+        block_q = 1024
+        if jnp.dtype(q.dtype).itemsize >= 4 or rt >= 2048:
+            block_q = 512
     bq = min(block_q, rt)
     # Clamp block_k to the q-rounded sequence length: t_pad is a multiple of
     # max(bq, bk), so an unclamped default (1024) would pad mid-size
