@@ -138,7 +138,7 @@ class TestFsdpModel:
         wq = m.params["residual"]["main"]["multi_head_attention"]["wq"]
         assert set(ax for ax in wq.sharding.spec if ax) == {"model", "fsdp"}
         # Optimizer state inherits the composed shardings.
-        mu_wq = m.opt_state[0].mu["residual"]["main"]["multi_head_attention"]["wq"]
+        mu_wq = m.opt_state.inner_state[0].mu["residual"]["main"]["multi_head_attention"]["wq"]
         assert mu_wq.sharding.spec == wq.sharding.spec
 
     def test_matches_dp_numerics(self, devices):

@@ -47,7 +47,7 @@ class TestFSDP:
         ck = model.params["conv2d"]["kernel"]
         assert ck.sharding.spec == PartitionSpec(None, None, None, "fsdp")
         # momentum shards like its param
-        mom = model.opt_state[0].trace["dense"]["kernel"]
+        mom = model.opt_state.inner_state[0].trace["dense"]["kernel"]
         assert mom.sharding.spec == PartitionSpec("fsdp", None)
 
     def test_scalar_and_awkward_shapes_replicate(self, devices):
