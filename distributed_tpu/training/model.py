@@ -132,12 +132,25 @@ class Model:
         loss="sparse_categorical_crossentropy",
         metrics: Iterable = ("accuracy",),
         grad_clip: Optional[float] = None,
+        gradient_accumulation_steps: Optional[int] = None,
         **optimizer_kwargs,
     ):
         """``grad_clip``: global-norm gradient clipping applied before the
         optimizer update (optax.clip_by_global_norm); the norm reduction
         happens inside the jitted step, so under data parallelism it clips
-        the *global* (all-reduced) gradient, not per-replica shards."""
+        the *global* (all-reduced) gradient, not per-replica shards.
+
+        ``gradient_accumulation_steps=N``: accumulate gradients over N
+        ``fit`` steps and apply the (mean-gradient) optimizer update on
+        every N-th (optax.MultiSteps) — trains with an effective global
+        batch of N x batch_size without the activation memory. Clipping
+        composes on the ACCUMULATED gradient (the clip transform sits
+        inside the MultiSteps wrapper). ``model.step`` still advances per
+        micro-step and checkpoints resume mid-accumulation exactly (the
+        accumulator rides in the optimizer state) — but LEARNING-RATE
+        SCHEDULES advance once per optimizer update, i.e. once per N fit
+        steps: size a schedule in UPDATES (total_fit_steps / N), not fit
+        steps."""
         self.tx = optim.get(optimizer, **optimizer_kwargs)
         if grad_clip is not None:
             if grad_clip <= 0:
@@ -145,6 +158,15 @@ class Model:
             self.tx = optax.chain(
                 optax.clip_by_global_norm(float(grad_clip)), self.tx
             )
+        if gradient_accumulation_steps is not None:
+            n = gradient_accumulation_steps
+            if not isinstance(n, (int, np.integer)) or n < 1:
+                raise ValueError(
+                    "gradient_accumulation_steps must be an integer >= 1, "
+                    f"got {gradient_accumulation_steps!r}"
+                )
+            if n > 1:
+                self.tx = optax.MultiSteps(self.tx, every_k_schedule=int(n))
         self.loss_fn = losses_lib.get(loss)
         self.metric_fns = [(metrics_lib.name_of(m), metrics_lib.get(m)) for m in metrics]
         self.compiled = True

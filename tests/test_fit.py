@@ -146,3 +146,45 @@ def test_validation_data_accepts_pipeline(devices):
             yield next(val_pipe2)
     with pytest.raises(ValueError, match="steps"):
         m.evaluate(gen(), verbose=0)
+
+
+def test_gradient_accumulation_matches_large_batch():
+    """compile(gradient_accumulation_steps=N): N micro-steps with batch b
+    equal ONE step at batch N*b (SGD is linear in the mean gradient), and
+    params stay frozen on non-boundary micro-steps."""
+    import jax
+
+    x, y = small_data(n=256)
+    big = make_model()
+    big.fit(x[:128], y[:128], batch_size=128, epochs=1, steps_per_epoch=1,
+            verbose=0, seed=0, shuffle=False)
+
+    acc = dtpu.Model(dtpu.models.mnist_cnn())
+    acc.compile(optimizer=dtpu.optim.SGD(0.05),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"], gradient_accumulation_steps=2)
+    acc.build((28, 28, 1), seed=0)
+    p0 = [np.asarray(l) for l in jax.tree_util.tree_leaves(acc.params)]
+    acc.fit(x[:64], y[:64], batch_size=64, epochs=1, steps_per_epoch=1,
+            verbose=0, seed=0, shuffle=False)
+    p1 = [np.asarray(l) for l in jax.tree_util.tree_leaves(acc.params)]
+    for a, b in zip(p0, p1):  # first micro-step: no update applied
+        np.testing.assert_array_equal(a, b)
+    acc.fit(x[64:128], y[64:128], batch_size=64, epochs=1, steps_per_epoch=1,
+            verbose=0, seed=0, shuffle=False)
+    for got, want in zip(jax.tree_util.tree_leaves(acc.params),
+                         jax.tree_util.tree_leaves(big.params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-6)
+    # Injected hyperparams stay reachable through the MultiSteps wrapper.
+    acc.set_learning_rate(0.01)
+    assert abs(acc.get_learning_rate() - 0.01) < 1e-9
+
+
+def test_gradient_accumulation_validation():
+    m = dtpu.Model(dtpu.models.mnist_cnn())
+    for bad in (0, -1, 2.5):
+        with pytest.raises(ValueError, match="gradient_accumulation_steps"):
+            m.compile(optimizer="sgd",
+                      loss="sparse_categorical_crossentropy",
+                      gradient_accumulation_steps=bad)
