@@ -625,10 +625,44 @@ class Model:
         return out
 
     # ---------------------------------------------------------------- predict
-    def predict(self, x, batch_size: int = 32) -> np.ndarray:
-        x = np.asarray(x)
+    def predict(self, x, batch_size: int = 32, steps: Optional[int] = None
+                ) -> np.ndarray:
+        """Logits as a NumPy array. ``x``: host array, or a batch iterator
+        (e.g. ``data.Pipeline`` — Keras's predict(generator) shape); an
+        iterator yields (x_batch, y_batch) or bare x_batch for ``steps``
+        batches (default: one pass for sources with ``steps_per_pass``).
+        NOTE a Pipeline drops the non-divisible remainder (its one pass is
+        floor(n / batch_size) batches), so iterator predictions cover
+        batch_size * steps rows — pass host arrays when you need logits
+        for every row."""
         if not self.built:
             raise RuntimeError("Model not built")
+        if hasattr(x, "__next__"):
+            if steps is None:
+                steps = getattr(x, "steps_per_pass", None)
+                if steps is None:
+                    raise ValueError(
+                        "steps is required when predicting from a plain "
+                        "iterator (sources with steps_per_pass, e.g. "
+                        "data.Pipeline, default to one pass)"
+                    )
+            # A per-host-sharded Pipeline emits only this process's rows of
+            # each batch; placement assembles the global batch (the same
+            # detection fit()/evaluate() use).
+            per_host = isinstance(getattr(x, "shard", None), tuple)
+            step_fn = self._get_predict_step()
+            outs = []
+            for _ in range(int(steps)):
+                batch = next(x)
+                xb = batch[0] if isinstance(batch, tuple) else batch
+                xb = self.strategy.put_batch(
+                    {"x": np.asarray(xb)}, per_host=per_host
+                )["x"]
+                outs.append(np.asarray(
+                    jax.device_get(step_fn(self.params, self.state, xb))
+                ))
+            return np.concatenate(outs, axis=0)
+        x = np.asarray(x)
         n = x.shape[0]
         self.strategy.local_batch_size(batch_size)
         step_fn = self._get_predict_step()

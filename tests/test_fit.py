@@ -188,3 +188,26 @@ def test_gradient_accumulation_validation():
             m.compile(optimizer="sgd",
                       loss="sparse_categorical_crossentropy",
                       gradient_accumulation_steps=bad)
+
+
+def test_predict_from_pipeline_matches_arrays():
+    """Keras's predict(generator) shape: a Pipeline source predicts the
+    same logits as the equivalent host arrays (one pass, no shuffle)."""
+    x, y = dtpu.data.synthetic_images(128, (28, 28), 10, seed=4)
+    m = make_model()
+    m.build((28, 28, 1))
+    pipe = dtpu.data.Pipeline(x[..., None], y, 32, seed=0, shuffle=False)
+    got = m.predict(pipe)
+    want = m.predict(x[..., None].astype(np.float32) / 255.0, batch_size=32)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def gen():
+        while True:
+            yield x[..., None].astype(np.float32) / 255.0
+    with pytest.raises(ValueError, match="steps"):
+        m.predict(gen())
+    # unbuilt model fails loudly on the iterator path too
+    fresh = make_model()
+    pipe2 = dtpu.data.Pipeline(x[..., None], y, 32, seed=0, shuffle=False)
+    with pytest.raises(RuntimeError, match="not built"):
+        fresh.predict(pipe2)
