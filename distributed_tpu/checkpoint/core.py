@@ -274,6 +274,19 @@ class Checkpointer:
             template = model.strategy.init_opt_state(model.tx, model.params)
             leaves = jax.tree_util.tree_leaves(tree["opt_state"])
             treedef = jax.tree_util.tree_structure(template)
+            if len(leaves) != treedef.num_leaves:
+                raise ValueError(
+                    f"Checkpoint optimizer state has {len(leaves)} leaves "
+                    f"but this model's optimizer expects "
+                    f"{treedef.num_leaves}. The optimizer-state FORMAT "
+                    "changed (named optimizers carry injected "
+                    "hyperparameters since round 4; gradient accumulation "
+                    "adds a MultiSteps accumulator) — or compile() used a "
+                    "different optimizer than the checkpointing run. To "
+                    "keep the weights, load params/state only "
+                    "(Model.load_weights on an exported file) and let the "
+                    "optimizer state reinitialize."
+                )
             shardings = jax.tree_util.tree_map(lambda a: a.sharding, template)
             model.opt_state = jax.device_put(
                 jax.tree_util.tree_unflatten(treedef, leaves), shardings

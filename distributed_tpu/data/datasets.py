@@ -223,16 +223,40 @@ def fetch_mnist(dest_dir: Optional[str] = None,
         return None
     # Cheap egress probe first: a firewall that silently DROPs packets would
     # otherwise stall every urlopen for the full timeout (2 mirrors x 4
-    # files); a 3s TCP connect bounds the hermetic-machine cost.
-    reachable = []
-    for mirror in _MNIST_MIRRORS:
+    # files). The probes run in DAEMON threads with a hard join deadline:
+    # socket timeouts do NOT bound the DNS lookup inside create_connection
+    # (a blackholed resolver can block getaddrinfo for the system resolver
+    # timeout), and daemon threads — unlike ThreadPoolExecutor workers —
+    # are not joined at interpreter exit, so a stuck probe can't stall
+    # process shutdown either.
+    import threading
+
+    results = {}
+
+    def _probe(mirror):
         host = urllib.parse.urlparse(mirror).hostname
         port = 443 if mirror.startswith("https") else 80
         try:
             socket.create_connection((host, port), timeout=3.0).close()
-            reachable.append(mirror)
+            results[mirror] = True
         except OSError:
-            continue
+            results[mirror] = False
+
+    threads = [
+        threading.Thread(target=_probe, args=(m,), daemon=True)
+        for m in _MNIST_MIRRORS
+    ]
+    deadline = 4.0
+    import time as _time
+
+    t0 = _time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(max(0.0, deadline - (_time.monotonic() - t0)))
+    # Mirror-preference order preserved: _MNIST_MIRRORS is most reliable
+    # first, and the download loop tries `reachable` in order.
+    reachable = [m for m in _MNIST_MIRRORS if results.get(m)]
     if not reachable:
         return None
     for fname in _MNIST_FILES:

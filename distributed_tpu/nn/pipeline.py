@@ -265,15 +265,25 @@ class PipelinedBlocks(Layer):
 
         mesh, pipe_axis = _live_pipe_mesh(current_strategy())
         stacked = params["blocks"]
-        if mesh is not None and self.num_blocks % int(mesh.shape[pipe_axis]):
-            # Same loud failure as apply(): silently taking the gather-
-            # everything path would materialize the full stack on every
-            # device — the opposite of what a pipe mesh promises.
-            raise ValueError(
-                f"{self.num_blocks} blocks not divisible by "
-                f"{pipe_axis}={int(mesh.shape[pipe_axis])} stages"
-            )
-        if mesh is None or not jax.tree_util.tree_leaves(cache):
+        if mesh is not None:
+            # Loud failures for every config the ring schedule can't run:
+            # silently taking the gather-everything path would materialize
+            # the full stack on every device — the opposite of what a pipe
+            # mesh promises.
+            if self.num_blocks % int(mesh.shape[pipe_axis]):
+                raise ValueError(
+                    f"{self.num_blocks} blocks not divisible by "
+                    f"{pipe_axis}={int(mesh.shape[pipe_axis])} stages"
+                )
+            if not jax.tree_util.tree_leaves(cache):
+                raise ValueError(
+                    "PipelinedBlocks.decode on a live pipe mesh needs a "
+                    "per-block cache (the template block's init_cache "
+                    "returned nothing) — a cacheless stack would scan the "
+                    "pipe-sharded params and all-gather the full stack on "
+                    "every rank; decode off the pipe mesh instead"
+                )
+        if mesh is None:
             return stacked_decode(self.block, stacked, {}, cache, x, pos=pos)
 
         # Memory-sharded ring decode (class comment): every rank holds its

@@ -102,6 +102,13 @@ class ModelCheckpoint(Callback):
             self.ckpt.save(model)
 
 
+def _metric_mode(monitor: str) -> str:
+    """'max' for higher-is-better metric names, else 'min' — THE auto-mode
+    rule, shared by every plateau-style callback so they can't disagree
+    about the same monitor."""
+    return "max" if ("acc" in monitor or monitor.endswith("auc")) else "min"
+
+
 class EarlyStopping(Callback):
     """Stop training when a monitored metric stops improving.
 
@@ -118,7 +125,7 @@ class EarlyStopping(Callback):
         if mode not in ("auto", "min", "max"):
             raise ValueError(f"mode must be auto/min/max, got {mode!r}")
         if mode == "auto":
-            mode = "max" if ("acc" in monitor or monitor.endswith("auc")) else "min"
+            mode = _metric_mode(monitor)
         self.mode = mode
         self.restore_best = restore_best
         self._best = math.inf if mode == "min" else -math.inf
@@ -205,11 +212,35 @@ class LearningRateScheduler(Callback):
     def __init__(self, schedule, verbose: int = 0):
         self.schedule = schedule
         self.verbose = int(verbose)
+        # Explicit arity inspection, NOT try/except TypeError: the fallback
+        # would also swallow TypeErrors raised inside a two-argument
+        # schedule's body, masking the user's real bug (the R binding does
+        # the same via length(formals(...))). Builtins/callables whose
+        # signature can't be inspected default to the 1-arg form.
+        import inspect
+
+        try:
+            kinds = [p.kind for p in
+                     inspect.signature(schedule).parameters.values()]
+            positional = sum(
+                k in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD)
+                for k in kinds
+            )
+            # *args can absorb the second argument; keyword-only/**kwargs
+            # cannot receive a positional lr.
+            two_arg = positional >= 2 or (
+                positional >= 1
+                and inspect.Parameter.VAR_POSITIONAL in kinds
+            )
+        except (TypeError, ValueError):
+            two_arg = False
+        self._two_arg = two_arg
 
     def on_epoch_begin(self, model, epoch):
-        try:
+        if self._two_arg:
             lr = self.schedule(epoch, model.get_learning_rate())
-        except TypeError:
+        else:
             lr = self.schedule(epoch)
         model.set_learning_rate(float(lr))
         if self.verbose and jax.process_index() == 0:
@@ -226,7 +257,8 @@ class ReduceLROnPlateau(Callback):
 
     def __init__(self, monitor: str = "loss", *, factor: float = 0.5,
                  patience: int = 3, min_delta: float = 1e-4,
-                 min_lr: float = 0.0, cooldown: int = 0, verbose: int = 0):
+                 min_lr: float = 0.0, cooldown: int = 0, mode: str = "auto",
+                 verbose: int = 0):
         if not 0.0 < factor < 1.0:
             raise ValueError(f"factor must be in (0, 1), got {factor}")
         self.monitor = monitor
@@ -235,6 +267,9 @@ class ReduceLROnPlateau(Callback):
         self.min_delta = float(min_delta)
         self.min_lr = float(min_lr)
         self.cooldown = int(cooldown)
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto/min/max, got {mode!r}")
+        self.mode = _metric_mode(monitor) if mode == "auto" else mode
         self.verbose = int(verbose)
         self._best = math.inf
         self._wait = 0
@@ -253,15 +288,23 @@ class ReduceLROnPlateau(Callback):
                 f"({sorted(logs)})"
             )
             return
-        # Higher-is-better metrics (accuracy-like) are negated so the
-        # plateau test is always minimization, like EarlyStopping.
-        sign = -1.0 if "acc" in self.monitor else 1.0
+        # Max-mode metrics are negated so the plateau test is always
+        # minimization; the auto rule is _metric_mode, SHARED with
+        # EarlyStopping so the two can't disagree about one monitor.
+        sign = -1.0 if self.mode == "max" else 1.0
         val = sign * float(cur)
+        # Best-tracking continues through cooldown (Keras semantics):
+        # cooldown only suppresses the plateau counter, so a transient
+        # improvement during cooldown can't later masquerade as progress
+        # against a stale best.
+        improved = val < self._best - self.min_delta
+        if improved:
+            self._best = val
         if self._cooling > 0:
             self._cooling -= 1
+            self._wait = 0
             return
-        if val < self._best - self.min_delta:
-            self._best = val
+        if improved:
             self._wait = 0
             return
         self._wait += 1

@@ -76,6 +76,16 @@ def _index_stream(
         pass_idx += 1
 
 
+def _per_host_source(source) -> bool:
+    """True when a batch source emits only THIS process's rows of each
+    global batch — specifically a (process_index, process_count) ``shard``
+    tuple, the shape data.Pipeline(shard=...) sets. NOT any ``shard``
+    attribute: a tf.data-style .shard() METHOD must not trigger per-host
+    placement. One definition shared by fit/evaluate/predict so the three
+    entry points cannot disagree about what counts as a sharded source."""
+    return isinstance(getattr(source, "shard", None), tuple)
+
+
 class Model:
     """A trainable wrapper around a ``Layer`` (usually a ``Sequential``)."""
 
@@ -341,10 +351,7 @@ class Model:
             batch_size = getattr(source, "batch_size", batch_size)
             # A per-host-sharded source (data.Pipeline(shard=(i, P))) emits
             # only this process's rows; placement assembles the global batch.
-            # Specifically a (process_index, process_count) tuple, the shape
-            # data.Pipeline(shard=...) sets — NOT any `shard` attribute (a
-            # tf.data-style .shard() method must not trigger per-host mode).
-            per_host = isinstance(getattr(source, "shard", None), tuple)
+            per_host = _per_host_source(source)
             if steps_per_epoch is None:
                 steps_per_epoch = getattr(source, "steps_per_pass", None)
                 if steps_per_epoch is None:
@@ -576,7 +583,7 @@ class Model:
                     "data.Pipeline, default to one pass)"
                 )
         # A sharded Pipeline emits only this host's rows of each batch.
-        per_host = getattr(source, "shard", None) is not None
+        per_host = _per_host_source(source)
         step_fn = self._get_eval_step()
         results = []
         rows = 0
@@ -630,7 +637,9 @@ class Model:
         """Logits as a NumPy array. ``x``: host array, or a batch iterator
         (e.g. ``data.Pipeline`` — Keras's predict(generator) shape); an
         iterator yields (x_batch, y_batch) or bare x_batch for ``steps``
-        batches (default: one pass for sources with ``steps_per_pass``).
+        batches (default: one pass for sources with ``steps_per_pass``);
+        on the iterator path ``batch_size`` is IGNORED — batch shape comes
+        from the source.
         NOTE a Pipeline drops the non-divisible remainder (its one pass is
         floor(n / batch_size) batches), so iterator predictions cover
         batch_size * steps rows — pass host arrays when you need logits
@@ -649,17 +658,29 @@ class Model:
             # A per-host-sharded Pipeline emits only this process's rows of
             # each batch; placement assembles the global batch (the same
             # detection fit()/evaluate() use).
-            per_host = isinstance(getattr(x, "shard", None), tuple)
+            per_host = _per_host_source(x)
             step_fn = self._get_predict_step()
+            # _to_host, not device_get: per-host batches make the logits
+            # span non-addressable devices on multi-process runs; the
+            # checkpoint helper gathers those collectively.
+            from ..checkpoint.core import _to_host
+
             outs = []
-            for _ in range(int(steps)):
-                batch = next(x)
+            for step_i in range(int(steps)):
+                try:
+                    batch = next(x)
+                except StopIteration:
+                    raise ValueError(
+                        f"prediction iterator exhausted after {step_i} of "
+                        f"{int(steps)} batches — pass a smaller steps or a "
+                        "repeating source (data.Pipeline)"
+                    ) from None
                 xb = batch[0] if isinstance(batch, tuple) else batch
                 xb = self.strategy.put_batch(
                     {"x": np.asarray(xb)}, per_host=per_host
                 )["x"]
                 outs.append(np.asarray(
-                    jax.device_get(step_fn(self.params, self.state, xb))
+                    _to_host(step_fn(self.params, self.state, xb))
                 ))
             return np.concatenate(outs, axis=0)
         x = np.asarray(x)

@@ -20,7 +20,12 @@ bitcasts (M, C) to (M/k, 128) (row-major contiguity makes columns
 sums after the kernel, recovering full lane utilization.
 
 The reference's equivalent lives inside TF's fused-BN CUDA/C++ kernels
-(SURVEY.md §2b D3/D4); this is the TPU-native answer. CPU/tests run in
+(SURVEY.md §2b D3/D4). NOT wired into nn.BatchNorm: with the round-4
+stats_shift="running" change the forward statistics fuse into the conv
+epilogue for free, and the backward can't win (conv outputs carry XLA's
+native {3,0,2,1} layout; Mosaic needs row-major, so the layout copy costs
+more than the kernel saves — docs/PERF.md). Kept WITH its profiling
+harness (profile_bn.py) as the record of that investigation. CPU runs in
 Pallas interpret mode.
 """
 

@@ -9,11 +9,16 @@ Usage: python examples/profile_bn.py
 
 import time
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from distributed_tpu.ops import bn_pallas
+import bn_pallas
 
 
 def sync1(v):

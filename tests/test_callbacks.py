@@ -287,3 +287,57 @@ def test_schedule_driven_lr_rejects_mutation():
     m.build((28, 28, 1))
     with pytest.raises(KeyError, match="schedule-driven"):
         m.set_learning_rate(0.01)
+
+
+def test_reduce_lr_max_mode_and_cooldown_best_tracking():
+    from distributed_tpu.training.callbacks import ReduceLROnPlateau
+
+    # auto max-mode for auc-suffixed monitors (shared rule with
+    # EarlyStopping): a rising AUC is improvement, no reduction.
+    m = _small_model()
+    m.build((28, 28, 1))
+    cb = ReduceLROnPlateau(monitor="val_auc", patience=1)
+    cb.on_train_begin(m)
+    for epoch, auc in enumerate([0.5, 0.6, 0.7, 0.8]):
+        cb.on_epoch_end(m, epoch, {"val_auc": auc})
+    assert abs(m.get_learning_rate() - 0.05) < 1e-9
+
+    # best keeps tracking THROUGH cooldown: the transient dip to 0.5 during
+    # cooldown sets the bar, so the later 0.9 is NOT an improvement and the
+    # next plateau reduces again (Keras semantics).
+    m2 = _small_model()
+    m2.build((28, 28, 1))
+    cb2 = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                            cooldown=2, min_delta=1e-3)
+    cb2.on_train_begin(m2)
+    cb2.on_epoch_end(m2, 0, {"loss": 1.0})
+    cb2.on_epoch_end(m2, 1, {"loss": 1.0})   # plateau -> reduce, cooldown=2
+    assert abs(m2.get_learning_rate() - 0.025) < 1e-9
+    cb2.on_epoch_end(m2, 2, {"loss": 0.5})   # cooling, but best updates
+    cb2.on_epoch_end(m2, 3, {"loss": 0.9})   # cooling
+    cb2.on_epoch_end(m2, 4, {"loss": 0.9})   # not an improvement vs 0.5
+    cb2.on_epoch_end(m2, 5, {"loss": 0.9})   # plateau again -> reduce
+    assert abs(m2.get_learning_rate() - 0.0125) < 1e-9
+
+
+def test_checkpoint_optimizer_format_mismatch_raises(tmp_path):
+    """A checkpoint whose optimizer-state leaf count doesn't match the
+    compiled optimizer (e.g. pre-inject_hyperparams formats, or a changed
+    optimizer) fails with a NAMED error, not a cryptic tree mismatch."""
+    import optax
+
+    x, y = _data(64)
+    m = dtpu.Model(dtpu.models.mnist_cnn())
+    m.compile(optimizer=optax.adam(1e-3),  # raw transform: no hyperparams
+              loss="sparse_categorical_crossentropy")
+    m.fit(x, y.astype(np.int32), batch_size=32, epochs=1,
+          steps_per_epoch=1, verbose=0)
+    ck = dtpu.Checkpointer(tmp_path / "ck")
+    ck.save(m)
+
+    m2 = dtpu.Model(dtpu.models.mnist_cnn())
+    m2.compile(optimizer=dtpu.optim.Adam(1e-3),  # injected-hyperparams state
+               loss="sparse_categorical_crossentropy")
+    m2.build((28, 28, 1))
+    with pytest.raises(ValueError, match="FORMAT"):
+        ck.restore_into(m2)
