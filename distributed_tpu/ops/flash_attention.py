@@ -760,9 +760,10 @@ def flash_attention(
     with a one-time warning.
 
     ``block_q=None`` (default) resolves to the swept 1024, scoped-VMEM-
-    clamped to 512 for float32 inputs or T >= 2048 (see the comment at the
-    clamp). An EXPLICIT block_q is honored as passed — sweeps on chips
-    with different VMEM budgets must measure what they ask for.
+    clamped to 512 for float32 inputs (any length) and for bf16 above
+    T=2048 (see the comment at the clamp). An EXPLICIT block_q is honored
+    as passed — sweeps on chips with different VMEM budgets must measure
+    what they ask for.
     """
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):
@@ -784,12 +785,15 @@ def flash_attention(
         #   failure at T>=2048 with 1024);
         # - bf16 at long sequence: the full-model BACKWARD kernel's stack
         #   (dq/dk/dv blocks + f32 stat rows spanning T) measured over the
-        #   limit at T=4096 with bq=1024; T in [2048, 4096) is unswept
-        #   borderline, so the clamp starts there conservatively. bq=512
+        #   limit at T=4096 with bq=1024. T=2048 compiles in-model and is
+        #   ~25% faster with 1024 (confirmed twice), so the bf16 clamp
+        #   starts strictly above it; (2048, 4096) is clamped — bq=512
         #   still beats the old 256 default by ~11% at T=4096
         #   (docs/PERF.md round-4 sweep).
         block_q = 1024
-        if jnp.dtype(q.dtype).itemsize >= 4 or rt >= 2048:
+        if jnp.dtype(q.dtype).itemsize >= 4:
+            block_q = 512
+        elif rt > 2048:
             block_q = 512
     bq = min(block_q, rt)
     # Clamp block_k to the q-rounded sequence length: t_pad is a multiple of

@@ -227,11 +227,12 @@ class LearningRateScheduler(Callback):
                       inspect.Parameter.POSITIONAL_OR_KEYWORD)
                 for k in kinds
             )
-            # *args can absorb the second argument; keyword-only/**kwargs
-            # cannot receive a positional lr.
-            two_arg = positional >= 2 or (
-                positional >= 1
-                and inspect.Parameter.VAR_POSITIONAL in kinds
+            # *args can absorb both positionals (e.g. an un-wrapped
+            # decorator's `def wrapper(*args, **kw)`); keyword-only /
+            # **kwargs cannot receive a positional lr.
+            two_arg = (
+                positional >= 2
+                or inspect.Parameter.VAR_POSITIONAL in kinds
             )
         except (TypeError, ValueError):
             two_arg = False
