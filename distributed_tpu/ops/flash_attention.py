@@ -791,9 +791,7 @@ def flash_attention(
         #   still beats the old 256 default by ~11% at T=4096
         #   (docs/PERF.md round-4 sweep).
         block_q = 1024
-        if jnp.dtype(q.dtype).itemsize >= 4:
-            block_q = 512
-        elif rt > 2048:
+        if jnp.dtype(q.dtype).itemsize >= 4 or rt > 2048:
             block_q = 512
     bq = min(block_q, rt)
     # Clamp block_k to the q-rounded sequence length: t_pad is a multiple of

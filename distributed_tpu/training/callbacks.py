@@ -215,8 +215,12 @@ class LearningRateScheduler(Callback):
         # Explicit arity inspection, NOT try/except TypeError: the fallback
         # would also swallow TypeErrors raised inside a two-argument
         # schedule's body, masking the user's real bug (the R binding does
-        # the same via length(formals(...))). Builtins/callables whose
-        # signature can't be inspected default to the 1-arg form.
+        # the same via length(formals(...))). Exactly one case is
+        # genuinely ambiguous — a bare *args signature (an un-wrapped
+        # decorator) hides the inner arity — and ONLY that case keeps a
+        # one-time call-and-fallback probe; inspectable signatures never
+        # get the masking fallback. Builtins/callables whose signature
+        # can't be inspected default to the 1-arg form.
         import inspect
 
         try:
@@ -227,19 +231,27 @@ class LearningRateScheduler(Callback):
                       inspect.Parameter.POSITIONAL_OR_KEYWORD)
                 for k in kinds
             )
-            # *args can absorb both positionals (e.g. an un-wrapped
-            # decorator's `def wrapper(*args, **kw)`); keyword-only /
-            # **kwargs cannot receive a positional lr.
-            two_arg = (
-                positional >= 2
-                or inspect.Parameter.VAR_POSITIONAL in kinds
+            two_arg = positional >= 2
+            ambiguous = (
+                positional < 2
+                and inspect.Parameter.VAR_POSITIONAL in kinds
             )
         except (TypeError, ValueError):
-            two_arg = False
+            two_arg, ambiguous = False, False
         self._two_arg = two_arg
+        self._ambiguous = ambiguous
 
     def on_epoch_begin(self, model, epoch):
-        if self._two_arg:
+        if self._ambiguous:
+            # Bare-*args wrapper: probe once with the richer 2-arg form,
+            # memoize whichever arity the inner callable accepts.
+            try:
+                lr = self.schedule(epoch, model.get_learning_rate())
+                self._two_arg = True
+            except TypeError:
+                lr = self.schedule(epoch)
+            self._ambiguous = False
+        elif self._two_arg:
             lr = self.schedule(epoch, model.get_learning_rate())
         else:
             lr = self.schedule(epoch)

@@ -341,3 +341,28 @@ def test_checkpoint_optimizer_format_mismatch_raises(tmp_path):
     m2.build((28, 28, 1))
     with pytest.raises(ValueError, match="FORMAT"):
         ck.restore_into(m2)
+
+
+def test_lr_scheduler_bare_args_wrappers_both_arities():
+    """A bare-*args decorator hides the inner arity; the one ambiguous
+    case probes once and memoizes — BOTH wrapped arities must work."""
+    from distributed_tpu.training.callbacks import LearningRateScheduler
+
+    def one_arg(epoch):
+        return 0.04
+
+    def two_arg(epoch, lr):
+        return lr * 0.5
+
+    def make_wrapper(f):
+        def wrapper(*args, **kw):  # no functools.wraps: bare-*args sig
+            return f(*args, **kw)
+        return wrapper
+
+    for inner, want in ((one_arg, 0.04), (two_arg, 0.025)):
+        m = _small_model()
+        m.build((28, 28, 1))
+        cb = LearningRateScheduler(make_wrapper(inner))
+        cb.on_epoch_begin(m, 0)
+        assert abs(m.get_learning_rate() - want) < 1e-9, inner.__name__
+        cb.on_epoch_begin(m, 1)  # memoized arity: second call works too

@@ -211,3 +211,35 @@ def test_predict_from_pipeline_matches_arrays():
     pipe2 = dtpu.data.Pipeline(x[..., None], y, 32, seed=0, shuffle=False)
     with pytest.raises(RuntimeError, match="not built"):
         fresh.predict(pipe2)
+
+
+def test_progress_bar_tty_redraws_in_place():
+    """On a TTY the line redraws with carriage returns and is cleared at
+    close() so the epoch summary prints cleanly (no test covered the
+    in-place branch)."""
+    import io
+
+    from distributed_tpu.training.progress import ProgressLine
+
+    class Tty(io.StringIO):
+        def isatty(self):
+            return True
+
+    stream = Tty()
+    bar = ProgressLine(10, prefix="Epoch 1/1: ", stream=stream)
+    bar._interval = 0.0  # draw on every update for the test
+    for i in range(1, 11):
+        bar.update(i)
+    bar.close()
+    out = stream.getvalue()
+    assert out.count("\r") >= 10          # in-place redraws
+    assert "10/10" in out and "ETA" in out
+    assert out.endswith("\r\x1b[K")       # cleared for the summary line
+    # non-tty stream: newline cadence, no control codes
+    plain = io.StringIO()
+    bar2 = ProgressLine(4, stream=plain)
+    for i in range(1, 5):
+        bar2.update(i)
+    bar2.close()
+    assert "\x1b[K" not in plain.getvalue()
+    assert plain.getvalue().endswith("\n")
