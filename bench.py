@@ -291,6 +291,48 @@ def bench_resnet50(global_batch=256, image_size=224, warmup=3, measure=20,
 
 
 # ---------------------------------------------------------- transformer LM --
+def _lm_fwd_flops_per_token(num_layers, d_model, seq_len, vocab):
+    """Analytic matmul FLOPs per token, forward: per block qkv+proj
+    (8 d^2) + MLP (2 d d_ff * 2, d_ff = 4d) + attention scores/values
+    (4 s d); LM head (2 d V). Shared by every LM bench row so the
+    TFLOP/MFU columns stay comparable."""
+    d_ff = 4 * d_model
+    return (
+        num_layers * (8 * d_model**2 + 4 * d_model * d_ff
+                      + 4 * seq_len * d_model)
+        + 2 * d_model * vocab
+    )
+
+
+def _lm_bench_run(batch, seq_len, vocab, num_layers, d_model, num_heads,
+                  warmup, measure, metrics=("accuracy",), **model_kw):
+    """Build + compile + stage + time one LM config; returns
+    (model, steps_per_sec). Shared by bench_transformer_lm/bench_longctx
+    so setup (loss, dtype, staging) can't drift between them."""
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
+    strategy = _strategy()
+    with strategy.scope():
+        model = dtpu.Model(
+            dtpu.models.transformer_lm(
+                vocab, num_layers=num_layers, d_model=d_model,
+                num_heads=num_heads, max_len=seq_len,
+                dtype=jnp.bfloat16, **model_kw,
+            )
+        )
+        model.compile(
+            optimizer=dtpu.optim.Adam(1e-4),
+            loss="pallas_sparse_categorical_crossentropy",
+            metrics=metrics,
+        )
+    model.build((seq_len,))
+    dev_batch = model.strategy.put_batch({
+        "x": tok[:, :-1].astype(np.int32),
+        "y": tok[:, 1:].astype(np.int32),
+    })
+    return model, _time_steps(model, dev_batch, warmup, measure)
+
+
 def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
                          d_model=768, num_heads=12, warmup=3, measure=20,
                          with_remat_variant=True):
@@ -298,30 +340,9 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
     the 32k-vocab head. Also reports a remat-policy variant (per-block
     jax.checkpoint with dots_with_no_batch_dims_saveable) — the memory/
     recompute trade long-context configs run with."""
-    rng = np.random.default_rng(0)
-    tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
-
     def run(**model_kw):
-        strategy = _strategy()
-        with strategy.scope():
-            model = dtpu.Model(
-                dtpu.models.transformer_lm(
-                    vocab, num_layers=num_layers, d_model=d_model,
-                    num_heads=num_heads, max_len=seq_len,
-                    dtype=jnp.bfloat16, **model_kw,
-                )
-            )
-            model.compile(
-                optimizer=dtpu.optim.Adam(1e-4),
-                loss="pallas_sparse_categorical_crossentropy",
-                metrics=["accuracy"],
-            )
-        model.build((seq_len,))
-        dev_batch = model.strategy.put_batch({
-            "x": tok[:, :-1].astype(np.int32),
-            "y": tok[:, 1:].astype(np.int32),
-        })
-        return model, _time_steps(model, dev_batch, warmup, measure)
+        return _lm_bench_run(batch, seq_len, vocab, num_layers, d_model,
+                             num_heads, warmup, measure, **model_kw)
 
     model, steps_per_sec = run()
     n_params = sum(
@@ -330,14 +351,8 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
     del model  # free the base model's params/opt-state before the variant
 
     tokens = batch * seq_len
-    d_ff = 4 * d_model
-    # Analytic matmul FLOPs per token, forward: per block qkv+proj (8 d^2) +
-    # MLP (2 d d_ff * 2) + attention scores/values (4 s d); LM head (2 d V).
-    fwd_per_token = (
-        num_layers * (8 * d_model**2 + 4 * d_model * d_ff
-                      + 4 * seq_len * d_model)
-        + 2 * d_model * vocab
-    )
+    fwd_per_token = _lm_fwd_flops_per_token(num_layers, d_model, seq_len,
+                                            vocab)
     tflops = steps_per_sec * 3.0 * fwd_per_token * tokens / 1e12
     out = {
         "metric": f"transformer_lm_{n_params//1_000_000}M_train_steps_per_sec",
@@ -365,8 +380,53 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
     return out
 
 
+# ------------------------------------------------------------ long context --
+def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
+                           (1, 8192, True), (1, 16384, True)),
+                  vocab=32768, num_layers=12, d_model=768, num_heads=12,
+                  warmup=3, measure=20):
+    """Single-chip long-context rows (docs/PERF.md table): the 136M LM at
+    (batch, seq, remat) configs — flash attention keeps attention O(T),
+    remat + dots_with_no_batch_dims_saveable bounds block residuals.
+    Opt-in mode (``python bench.py longctx``): ~4 large compiles.
+    """
+    rows = []
+    for batch, seq_len, remat in configs:
+        kw = {}
+        if remat:
+            kw = dict(
+                remat=True,
+                remat_policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        model, sps = _lm_bench_run(batch, seq_len, vocab, num_layers,
+                                   d_model, num_heads, warmup, measure,
+                                   metrics=(), **kw)
+        tokens = batch * seq_len
+        fwd_per_token = _lm_fwd_flops_per_token(num_layers, d_model,
+                                                seq_len, vocab)
+        tflops = sps * 3.0 * fwd_per_token * tokens / 1e12
+        rows.append({
+            "metric": f"lm_longctx_b{batch}_t{seq_len}"
+                      f"{'_remat' if remat else ''}",
+            "value": round(sps * tokens, 1),
+            "unit": "tokens/s",
+            "steps_per_sec": round(sps, 3),
+            "tflops": round(tflops, 4),
+            "mfu": _mfu(tflops),
+        })
+        del model
+    out = rows[0]
+    if len(rows) > 1:
+        # "rows", not "extra": main() uses "extra" for the flat top-level
+        # list, and a nested "extra" would hide rows from consumers that
+        # flatten one level.
+        out = dict(out)
+        out["rows"] = rows[1:]
+    return out
+
+
 def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
-    known = {"mnist", "convergence", "cifar", "resnet50", "lm"}
+    known = {"mnist", "convergence", "cifar", "resnet50", "lm", "longctx"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -382,6 +442,8 @@ def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
         extra.append(bench_resnet50())
     if "lm" in modes:
         extra.append(bench_transformer_lm())
+    if "longctx" in modes:
+        extra.append(bench_longctx())
     result = headline or extra.pop(0)
     if extra:
         result["extra"] = extra

@@ -90,3 +90,19 @@ def test_bench_cifar_smoke():
     assert out["value"] > 0
     assert out["images_per_sec"] > 0
     assert "cifar_cnn" in out["metric"]
+
+
+def test_bench_longctx_smoke():
+    # Tiny shapes: the code path (remat variants, flop math, row shapes)
+    # runs on the CPU sim; real numbers come from `python bench.py longctx`.
+    # batch 8: divisible across the 8-device sim's data axis.
+    out = bench.bench_longctx(
+        configs=((8, 32, False), (8, 64, True)),
+        vocab=64, num_layers=1, d_model=16, num_heads=2,
+        warmup=1, measure=2,
+    )
+    assert out["unit"] == "tokens/s" and out["value"] > 0
+    assert out["metric"] == "lm_longctx_b8_t32"
+    (row2,) = out["rows"]
+    assert row2["metric"] == "lm_longctx_b8_t64_remat"
+    assert row2["tflops"] > 0
