@@ -24,7 +24,9 @@ analytic ones (3x forward for training; 6ND + attention for the LM), not
 XLA's cost model.
 
 Each mode is a function with size parameters so tests/test_bench.py can
-smoke-run the exact code path on CPU with tiny shapes.
+smoke-run the exact code path on CPU with tiny shapes. Besides the default
+modes, ``python bench.py longctx`` measures the long-context rows
+(docs/PERF.md table) — opt-in, large compiles.
 """
 
 import json
