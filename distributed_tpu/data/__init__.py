@@ -4,6 +4,7 @@ from .datasets import (
     load_fashion_mnist,
     load_imagenet,
     fetch_mnist,
+    load_digits_real,
     load_mnist,
     synthetic_images,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "native_available",
     "load",
     "fetch_mnist",
+    "load_digits_real",
     "load_mnist",
     "load_fashion_mnist",
     "load_cifar10",

@@ -195,6 +195,21 @@ _MNIST_SHAPES = {
     "t10k-images-idx3-ubyte.gz": (10000, 28, 28),
     "t10k-labels-idx1-ubyte.gz": (10000,),
 }
+# Canonical MD5 digests of the four gzipped IDX files (the widely-published
+# values, e.g. torchvision.datasets.MNIST pins these same constants). A
+# mirror that serves different bytes — truncated, altered, or substituted —
+# is rejected before anything reaches the cache. ``DTPU_MNIST_NO_CHECKSUM=1``
+# disables the pin (escape hatch in case a future canonical re-encoding
+# changes the compressed bytes while the payload stays valid).
+_MNIST_MD5 = {
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+    "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+    "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+}
+# Hard cap on bytes read per file: the largest real file (train images) is
+# ~9.9MB compressed; a hostile or broken mirror can't exhaust host memory.
+_MNIST_MAX_BYTES = 12 * 1024 * 1024
 
 
 def fetch_mnist(dest_dir: Optional[str] = None,
@@ -263,13 +278,27 @@ def fetch_mnist(dest_dir: Optional[str] = None,
         path = dest / fname
         if path.exists():
             continue
+        check = os.environ.get("DTPU_MNIST_NO_CHECKSUM", "0") in ("", "0")
         payload = None
         for mirror in reachable:
             try:
                 with urllib.request.urlopen(
                     mirror + fname, timeout=timeout
                 ) as r:
-                    payload = r.read()
+                    # Bounded read: request one byte past the cap so an
+                    # oversized body is detectable without buffering it.
+                    payload = r.read(_MNIST_MAX_BYTES + 1)
+                if len(payload) > _MNIST_MAX_BYTES:
+                    payload = None
+                    continue
+                if check:
+                    import hashlib
+
+                    # A corrupt/tampered mirror is a per-mirror failure —
+                    # fall through to the next one, like the size cap.
+                    if hashlib.md5(payload).hexdigest() != _MNIST_MD5[fname]:
+                        payload = None
+                        continue
                 break
             except Exception:
                 continue
@@ -318,6 +347,60 @@ def load_mnist(
     if got is None:
         got = _synthetic_split(split, (28, 28), 10, synthetic_train_n, synthetic_test_n, 1234)
     return _finalize(*got, normalize=normalize, channels=1)
+
+
+def load_digits_real(
+    split: str = "train",
+    *,
+    normalize: bool = True,
+    image_size: int = 28,
+    test_fraction: float = 0.2,
+    seed: int = 0,
+) -> Arrays:
+    """Real handwritten digits from scikit-learn's bundled UCI ML set.
+
+    1,797 genuine 8x8 grayscale scans (sklearn ships them offline — no
+    network needed), bilinearly upsampled to ``image_size`` and rescaled to
+    0-255 so the reference's MNIST CNN input contract
+    (/root/reference/README.md:53-56) applies unchanged. Split is a
+    deterministic stratified holdout (same ``seed`` => same partition on
+    every machine), so train/test never leak into each other.
+
+    This is the real-data fallback for the convergence benchmark on
+    machines where the MNIST IDX files are absent and there is no network
+    egress: small, but every pixel was drawn by a human hand.
+    """
+    try:
+        from sklearn.datasets import load_digits as _sk_load_digits
+    except ImportError as e:  # pragma: no cover - sklearn is baked in here
+        raise FileNotFoundError(
+            "scikit-learn (which bundles the real digits set) is not "
+            "installed"
+        ) from e
+    bunch = _sk_load_digits()
+    imgs = bunch.images.astype(np.float32) * (255.0 / 16.0)
+    labels = bunch.target.astype(np.int32)
+    rng = np.random.default_rng(seed)
+    train_idx, test_idx = [], []
+    for c in range(10):
+        idx = np.where(labels == c)[0]
+        rng.shuffle(idx)
+        k = int(round(len(idx) * test_fraction))
+        test_idx.append(idx[:k])
+        train_idx.append(idx[k:])
+    pick = np.concatenate(train_idx if split == "train" else test_idx)
+    rng.shuffle(pick)
+    imgs, labels = imgs[pick], labels[pick]
+    if image_size != imgs.shape[1]:
+        try:
+            from scipy.ndimage import zoom
+            scale = image_size / imgs.shape[1]
+            imgs = zoom(imgs, (1, scale, scale), order=1)
+        except ImportError:  # nearest-neighbor fallback, no scipy
+            src = (np.arange(image_size) * imgs.shape[1]) // image_size
+            imgs = imgs[:, src][:, :, src]
+    x = np.clip(imgs, 0, 255).astype(np.uint8)
+    return _finalize(x, labels, normalize=normalize, channels=1)
 
 
 def load_fashion_mnist(split: str = "train", **kw) -> Arrays:
