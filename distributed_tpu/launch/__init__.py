@@ -2,6 +2,7 @@ from .core import (
     LocalLauncher,
     SSHLauncher,
     WorkerResult,
+    heartbeat,
     launch_local,
     report_result,
     run_with_restart,
@@ -11,6 +12,7 @@ __all__ = [
     "LocalLauncher",
     "SSHLauncher",
     "WorkerResult",
+    "heartbeat",
     "launch_local",
     "report_result",
     "run_with_restart",

@@ -30,6 +30,13 @@ def main(argv=None):
     ap.add_argument("--python", type=str, default=sys.executable)
     ap.add_argument("--results-json", type=str, default=None,
                     help="write the worker result rows to this file")
+    ap.add_argument("--liveness-timeout", type=float, default=None,
+                    help="kill a worker whose heartbeat (emitted per batch "
+                         "by Model.fit) stalls for this many seconds — "
+                         "catches hung-but-alive workers (deadlocked "
+                         "collective) instead of waiting out --timeout; "
+                         "arms per worker after its first beat, so slow "
+                         "jit compiles never trip it")
     ap.add_argument("--max-restarts", type=int, default=0,
                     help="on worker failure, relaunch the whole gang up to "
                          "N times; pair with ModelCheckpoint(restore=True) "
@@ -45,7 +52,7 @@ def main(argv=None):
         launcher = core.SSHLauncher(args.hosts.split(","), **kw)
         results = core.run_with_restart(
             launcher, worker_argv, max_restarts=args.max_restarts,
-            timeout=args.timeout,
+            timeout=args.timeout, liveness_timeout=args.liveness_timeout,
         )
     else:
         n = args.num_workers or 1
@@ -53,6 +60,7 @@ def main(argv=None):
             core.LocalLauncher(), worker_argv, n,
             max_restarts=args.max_restarts,
             timeout=args.timeout, base_port=args.base_port,
+            liveness_timeout=args.liveness_timeout,
         )
 
     rows = [

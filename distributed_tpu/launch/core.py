@@ -37,6 +37,47 @@ from ..utils import logging as dlog
 RESULT_ENV = "DTPU_RESULT_FILE"
 RESULT_STDOUT_ENV = "DTPU_RESULT_STDOUT"  # ssh mode: frame result on stdout
 STDOUT_MARK = "___DTPU_RESULT___"
+HEARTBEAT_ENV = "DTPU_HEARTBEAT_FILE"  # local mode: touch this file
+HEARTBEAT_STDOUT_ENV = "DTPU_HEARTBEAT_STDOUT"  # ssh mode: tick on stdout
+HEARTBEAT_MARK = "___DTPU_HB___"
+PID_MARK = "___DTPU_PID___"  # ssh mode: remote worker announces its pid
+
+_last_heartbeat = 0.0
+
+
+def heartbeat(min_interval: float = 0.5) -> None:
+    """Publish worker liveness to the launcher (no-op outside a gang).
+
+    The training loop calls this every batch (training/model.py), so a
+    worker that is *computing* keeps beating while one stuck at a
+    collective, deadlocked, or SIGSTOPped goes silent — the launcher's
+    ``liveness_timeout`` then treats it like a crashed peer (gang-kill +
+    restart) instead of burning the full run ``timeout``
+    (/root/reference/README.md:400's "restart if any fails", extended to
+    hung-but-alive workers). Custom loops can call it directly.
+
+    Transport matches the launcher: an mtime touch on ``$DTPU_HEARTBEAT_FILE``
+    for local gangs, a marker line on stdout for ssh workers. Throttled to
+    one beat per ``min_interval`` seconds so a fast step loop costs nothing.
+    """
+    global _last_heartbeat
+    now = time.monotonic()
+    if now - _last_heartbeat < min_interval:
+        return
+    path = os.environ.get(HEARTBEAT_ENV)
+    tick_stdout = os.environ.get(HEARTBEAT_STDOUT_ENV) == "1"
+    if not path and not tick_stdout:
+        return
+    _last_heartbeat = now
+    if path:
+        try:
+            with open(path, "a"):
+                pass
+            os.utime(path, None)
+        except OSError:
+            pass
+    if tick_stdout:
+        print(HEARTBEAT_MARK, flush=True)
 
 
 @dataclasses.dataclass
@@ -100,7 +141,19 @@ class LocalLauncher:
         grace: float = 10.0,
         workdir: Optional[str] = None,
         base_port: Optional[int] = None,
+        liveness_timeout: Optional[float] = None,
     ) -> List[WorkerResult]:
+        """``liveness_timeout``: seconds a worker may go without a heartbeat
+        (``launch.heartbeat()``, called per batch by Model.fit and its
+        eval/epoch-boundary loops) before it is treated as hung — killed
+        and recorded as failed, which then gang-kills its peers after
+        ``grace`` exactly like a crash. ``None`` (default) disables the
+        probe. The probe arms per worker only after its FIRST beat, so
+        slow startup/compile never trips it — but later SINGLE blocking
+        operations (the eval graph's first jit compile, a large checkpoint
+        write) emit no beats while they run, so choose a liveness_timeout
+        comfortably above the longest such operation, not above a step
+        time."""
         if base_port is not None:
             ports = [base_port + i for i in range(num_workers)]
         else:
@@ -108,12 +161,14 @@ class LocalLauncher:
         workers = [f"127.0.0.1:{p}" for p in ports]
         tmp = Path(tempfile.mkdtemp(prefix="dtpu_launch_"))
         procs = []
+        hb_paths = [tmp / f"heartbeat-{i}" for i in range(num_workers)]
         for i in range(num_workers):
             spec = config_lib.ClusterSpec(workers=workers, index=i)
             env = dict(os.environ)
             env.update(self.env_extra)
             env[config_lib.ENV_VAR] = spec.to_json()
             env[RESULT_ENV] = str(tmp / f"result-{i}.json")
+            env[HEARTBEAT_ENV] = str(hb_paths[i])
             log = open(tmp / f"worker-{i}.log", "wb")
             procs.append(
                 (
@@ -131,6 +186,21 @@ class LocalLauncher:
         results: List[Optional[WorkerResult]] = [None] * num_workers
         pending = set(range(num_workers))
         first_failure: Optional[float] = None
+
+        def kill_and_record(i: int, reason: str):
+            proc, _ = procs[i]
+            proc.kill()
+            proc.wait()
+            pending.discard(i)
+            results[i] = WorkerResult(
+                index=i,
+                ok=False,
+                value=_read_result(tmp / f"result-{i}.json"),
+                error=reason,
+                exit_code=None,
+                log_tail=_tail(tmp / f"worker-{i}.log"),
+            )
+
         while pending:
             now = time.time()
             for i in list(pending):
@@ -151,6 +221,21 @@ class LocalLauncher:
                     )
                     if rc != 0 and first_failure is None:
                         first_failure = now
+            if liveness_timeout is not None:
+                for i in list(pending):
+                    try:
+                        last = os.path.getmtime(hb_paths[i])
+                    except OSError:
+                        continue  # not armed until the first beat
+                    if now - last <= liveness_timeout:
+                        continue
+                    kill_and_record(
+                        i,
+                        f"liveness timeout (no heartbeat for "
+                        f"{liveness_timeout:.0f}s; worker hung?)",
+                    )
+                    if first_failure is None:
+                        first_failure = now
             if pending and (
                 now > deadline
                 or (first_failure is not None and now > first_failure + grace)
@@ -161,17 +246,7 @@ class LocalLauncher:
                     else "killed after peer failure (gang semantics)"
                 )
                 for i in list(pending):
-                    proc, _ = procs[i]
-                    proc.kill()
-                    proc.wait()
-                    results[i] = WorkerResult(
-                        index=i,
-                        ok=False,
-                        value=_read_result(tmp / f"result-{i}.json"),
-                        error=reason,
-                        exit_code=None,
-                        log_tail=_tail(tmp / f"worker-{i}.log"),
-                    )
+                    kill_and_record(i, reason)
                 pending.clear()
             time.sleep(0.05)
         for proc, log in procs:
@@ -203,7 +278,13 @@ class SSHLauncher:
         timeout: float = 3600.0,
         grace: float = 10.0,
         env_extra: Optional[Dict[str, str]] = None,
+        liveness_timeout: Optional[float] = None,
     ) -> List[WorkerResult]:
+        """``liveness_timeout``: see LocalLauncher.run — same contract, but
+        liveness rides stdout (``heartbeat()`` prints a marker line when
+        ``DTPU_HEARTBEAT_STDOUT=1``; any later output also counts as a
+        beat). Armed per worker only after its first marker, so compile
+        time and ssh startup never trip it."""
         workers = [f"{h}:{self.port}" for h in self.hosts]
         unreachable = [w for w, ok in net.preflight(workers).items() if not ok]
         if unreachable:
@@ -214,15 +295,21 @@ class SSHLauncher:
             exports = {
                 config_lib.ENV_VAR: spec.to_json(),
                 RESULT_STDOUT_ENV: "1",
+                HEARTBEAT_STDOUT_ENV: "1",
                 **(env_extra or {}),
             }
             # shlex.quote everything: env values hold JSON and argv may hold
             # paths with spaces; unquoted, the remote shell would word-split
-            # and expand $/backtick metacharacters.
-            export_str = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in exports.items()
+            # and expand $/backtick metacharacters. The worker announces its
+            # remote pid first and `exec`s so $$ IS the worker process —
+            # that pid is what a liveness kill must target (killing only
+            # the local ssh client leaves a hung remote worker holding the
+            # host's TPU chips, and the relaunched gang can't acquire them).
+            export_str = "; ".join(
+                f"export {k}={shlex.quote(v)}" for k, v in exports.items()
             )
-            remote = f"{export_str} {' '.join(shlex.quote(a) for a in argv)}"
+            cmd = " ".join(shlex.quote(a) for a in argv)
+            remote = f"echo {PID_MARK}$$; {export_str}; exec {cmd}"
             procs.append(
                 subprocess.Popen(
                     [self.ssh_cmd, host, remote],
@@ -231,20 +318,51 @@ class SSHLauncher:
                     text=True,
                 )
             )
-        # Drain all stdout pipes concurrently: one log-heavy worker must not
-        # fill its pipe and stall the gang at a collective while we block on
-        # a different worker's communicate() (the "never a hang" contract).
+        # Drain all stdout pipes concurrently, line by line: one log-heavy
+        # worker must not fill its pipe and stall the gang at a collective
+        # while we block on a different worker (the "never a hang"
+        # contract). Heartbeat marker lines update last_beat and are
+        # filtered out of the captured output.
         outs: List[Optional[str]] = [None] * len(procs)
+        last_beat: List[Optional[float]] = [None] * len(procs)
+        pids: List[Optional[int]] = [None] * len(procs)
 
-        # The monitor loop owns timeout enforcement (so a timeout kill is
-        # labeled "timeout", not misread as a peer failure); the drain
-        # communicate() deadline sits beyond it purely as a backstop.
         def _drain(i, proc):
+            buf = []
             try:
-                outs[i], _ = proc.communicate(timeout=timeout + grace + 30.0)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                outs[i], _ = proc.communicate()
+                for line in proc.stdout:
+                    if line.startswith(PID_MARK):
+                        try:
+                            pids[i] = int(line[len(PID_MARK):].strip())
+                        except ValueError:
+                            pass
+                        continue
+                    if line.startswith(HEARTBEAT_MARK):
+                        last_beat[i] = time.time()
+                        continue
+                    buf.append(line)
+                    if last_beat[i] is not None:
+                        # Once armed, any output counts as liveness: a
+                        # worker busy printing logs is not hung.
+                        last_beat[i] = time.time()
+            finally:
+                outs[i] = "".join(buf)
+
+        def _remote_kill(i):
+            """Best-effort SIGKILL of the remote worker process itself:
+            killing only the local ssh client cannot stop a SIGSTOPped or
+            deadlocked remote (sshd's HUP is not deliverable to a stopped
+            process), which would keep holding the host's TPU chips."""
+            if pids[i] is None:
+                return
+            try:
+                subprocess.Popen(
+                    [self.ssh_cmd, self.hosts[i], f"kill -9 {pids[i]}"],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                )
+            except Exception:
+                pass
 
         drains = [
             threading.Thread(target=_drain, args=(i, p), daemon=True)
@@ -256,6 +374,7 @@ class SSHLauncher:
         # peers are blocked at their next collective waiting for it — kill
         # them after `grace` instead of letting them burn the full timeout.
         killed: set = set()
+        hung: set = set()
         first_failure: Optional[float] = None
         deadline = time.time() + timeout
         while any(p.poll() is None for p in procs):
@@ -264,6 +383,19 @@ class SSHLauncher:
                 p.poll() not in (None, 0) for p in procs
             ):
                 first_failure = now
+            if liveness_timeout is not None:
+                for i, p in enumerate(procs):
+                    if (
+                        p.poll() is None
+                        and i not in hung
+                        and last_beat[i] is not None
+                        and now - last_beat[i] > liveness_timeout
+                    ):
+                        hung.add(i)
+                        _remote_kill(i)
+                        p.kill()
+                        if first_failure is None:
+                            first_failure = now
             if now > deadline or (
                 first_failure is not None and now > first_failure + grace
             ):
@@ -274,11 +406,24 @@ class SSHLauncher:
                 for i, p in enumerate(procs):
                     if p.poll() is None:
                         killed.add(i)
+                        _remote_kill(i)
                         p.kill()
                 break
             time.sleep(0.2)
+        # Bounded drain joins ("never a hang"): a wrapper script or remote
+        # child that inherited stdout can hold the pipe open past the kill;
+        # close our read end to force EOF rather than blocking forever.
+        join_deadline = time.time() + 30.0
         for t in drains:
-            t.join()
+            t.join(max(0.0, join_deadline - time.time()))
+        for t, p in zip(drains, procs):
+            if t.is_alive():
+                try:
+                    p.stdout.close()
+                except Exception:
+                    pass
+        for t in drains:
+            t.join(5.0)
         results = []
         for i, proc in enumerate(procs):
             out = outs[i]
@@ -289,20 +434,26 @@ class SSHLauncher:
                         value = json.loads(line[len(self.MARK):])
                     except json.JSONDecodeError:
                         pass
-            if proc.returncode == 0:
+            if proc.returncode == 0 and i not in hung:
                 err = None
+            elif i in hung:
+                err = (
+                    f"liveness timeout (no heartbeat for "
+                    f"{liveness_timeout:.0f}s; worker hung?)"
+                )
             elif i in killed:
                 err = kill_reason
             else:
                 err = f"exit code {proc.returncode}"
+            ok = proc.returncode == 0 and i not in hung
             results.append(
                 WorkerResult(
                     index=i,
-                    ok=proc.returncode == 0,
+                    ok=ok,
                     value=value,
                     error=err,
                     exit_code=proc.returncode,
-                    log_tail="" if proc.returncode == 0 else (out or "")[-4096:],
+                    log_tail="" if ok else (out or "")[-4096:],
                 )
             )
         return results
@@ -345,10 +496,18 @@ def run_with_restart(
         except RuntimeError as e:
             # Keep the errors-as-data contract across attempts: an SSH
             # relaunch whose preflight finds the dead host unreachable
-            # raises — synthesize a failed row instead of propagating, so
-            # the caller always gets per-worker rows (and the backoff may
-            # outlast a transient outage).
-            results = [WorkerResult(index=0, ok=False, error=str(e))]
+            # raises — synthesize one failed row PER EXPECTED WORKER
+            # instead of propagating, so callers indexing results by rank
+            # see a stable shape across attempts (ADVICE r4).
+            n = run_kw.get("num_workers")
+            if n is None and run_args and isinstance(run_args[0], int):
+                n = run_args[0]
+            if n is None:
+                n = len(getattr(launcher, "hosts", None) or []) or 1
+            results = [
+                WorkerResult(index=i, ok=False, error=str(e))
+                for i in range(n)
+            ]
         if all(r.ok for r in results):
             return results
         if attempt >= max_restarts:

@@ -37,6 +37,7 @@ from ..nn.core import Layer
 from ..ops import losses as losses_lib
 from ..ops import metrics as metrics_lib
 from ..parallel.strategy import SingleDevice, Strategy, current_strategy
+from ..launch.core import heartbeat as _gang_heartbeat
 from ..utils import logging as dlog
 from ..utils.tree import tree_size
 from .progress import ProgressLine
@@ -472,6 +473,10 @@ class Model:
                     batch["x"], batch["y"], rng,
                 )
                 self.step += 1
+                # Liveness beat for gang launchers (throttled no-op outside
+                # a gang): a worker blocked at a collective stops beating
+                # and the launcher's liveness_timeout gang-restarts it.
+                _gang_heartbeat()
                 losses.append(loss)
                 for name, _ in self.metric_fns:
                     msums[name].append(mvals[name])
@@ -483,6 +488,10 @@ class Model:
                 bar.close()
             # One host sync per epoch.
             logs = {"loss": float(np.mean(jax.device_get(losses)))}
+            # The device_get above is where async dispatch catches up with
+            # real compute — beat again so the epoch-end window (sync +
+            # validation + callbacks below) starts freshly armed.
+            _gang_heartbeat()
             for name, pairs in msums.items():
                 pairs = jax.device_get(pairs)
                 s = sum(p[0] for p in pairs)
@@ -507,6 +516,11 @@ class Model:
             history.record(epoch, logs)
             for cb in callbacks:
                 cb.on_epoch_end(self, epoch, logs)
+                # Checkpoint writes etc. can be slow; keep beating between
+                # callbacks so a healthy epoch boundary is never read as a
+                # hang (liveness_timeout must still exceed any SINGLE
+                # blocking operation — see LocalLauncher.run's docstring).
+                _gang_heartbeat()
             if self.stop_training:
                 epochs = epoch + 1  # for the verbose epoch counter below
             if verbose and is_chief:
@@ -569,6 +583,7 @@ class Model:
             results.append(
                 step_fn(self.params, self.state, batch["x"], batch["y"], batch["m"])
             )
+            _gang_heartbeat()
         return self._finish_eval(results, n, verbose)
 
     def _evaluate_iterator(self, source, *, steps=None, verbose=1):
@@ -607,6 +622,7 @@ class Model:
                         batch["m"])
             )
             rows += xb.shape[0]
+            _gang_heartbeat()
         # Report GLOBAL rows: a sharded source yields only this host's
         # (1/P)-slice of every batch, so scale by the shard count when the
         # source doesn't carry an explicit global batch_size.

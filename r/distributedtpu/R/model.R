@@ -104,19 +104,25 @@ predict_on_batch <- function(object, x, batch_size = 32L) {
 #' @export
 summary_model <- function(object) object$summary()
 
-#' Save trained weights as HDF5 — the reference's model-exchange format
-#' (save_model_hdf5, README.md:237). Rank-0-only under SPMD.
+#' Save the trained model as HDF5 — the reference's model-exchange format
+#' (save_model_hdf5, README.md:237). Rank-0-only under SPMD. Captures
+#' params AND model state (BatchNorm running statistics): the reference's
+#' save_model_hdf5 captures everything needed to score
+#' (README.md:236-247), so a reloaded resnet50 must infer with its trained
+#' statistics, not reset ones. Delegates to Model$save_weights, whose
+#' {params, state} file layout Model$load_weights round-trips.
 #' @export
 save_model_hdf5 <- function(object, filepath) {
-  dtpu()$export_hdf5(filepath, object$params)
+  object$save_weights(filepath)
   invisible(filepath)
 }
 
-#' Load HDF5 weights into a built model.
+#' Load an HDF5 model saved by save_model_hdf5 into a built model.
+#' Also accepts bare-params interchange files (the pre-round-5 layout and
+#' other producers): Model$load_weights detects which layout it is reading.
 #' @export
 load_model_hdf5 <- function(object, filepath) {
-  loaded <- dtpu()$import_hdf5(filepath)
-  object$params <- object$strategy$put_params(loaded[[1]])
+  object$load_weights(filepath)
   invisible(object)
 }
 
