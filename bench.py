@@ -381,6 +381,7 @@ def _lm_bench_run(batch, seq_len, vocab, num_layers, d_model, num_heads,
     them."""
     rng = np.random.default_rng(0)
     tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
+    head_chunks = model_kw.pop("head_chunks", None)
     strategy = _strategy()
     with strategy.scope():
         model = dtpu.Model(
@@ -394,6 +395,7 @@ def _lm_bench_run(batch, seq_len, vocab, num_layers, d_model, num_heads,
             optimizer=dtpu.optim.Adam(1e-4),
             loss="pallas_sparse_categorical_crossentropy",
             metrics=metrics,
+            head_chunks=head_chunks,
         )
     model.build((seq_len,))
     dev_batch = model.strategy.put_batch({
@@ -404,13 +406,19 @@ def _lm_bench_run(batch, seq_len, vocab, num_layers, d_model, num_heads,
     return model, sps, window_rates
 
 
-def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
-                         d_model=768, num_heads=12, warmup=3, measure=20,
+def bench_transformer_lm(batch=32, seq_len=1024, vocab=32768, num_layers=12,
+                         d_model=768, num_heads=12, warmup=3, measure=15,
                          with_remat_variant=True):
     """~136M-param LM (GPT-2-small shape, untied head), Pallas fused xent on
     the 32k-vocab head. Also reports a remat-policy variant (per-block
     jax.checkpoint with dots_with_no_batch_dims_saveable) — the memory/
-    recompute trade long-context configs run with."""
+    recompute trade long-context configs run with.
+
+    batch 32 (round 5; was 8): per-op profiling showed the B=8 step leaves
+    the chip under-occupied AND pays the tunneled transport's per-dispatch
+    gap every 68 ms — B=32 runs the same model at 4x tokens/step, lifting
+    measured MFU 0.47 -> 0.53 on the same day/chip (docs/PERF.md round-5
+    notes). Fits comfortably without remat at T=1024 on a 16GB v5e."""
     def run(**model_kw):
         return _lm_bench_run(batch, seq_len, vocab, num_layers, d_model,
                              num_heads, warmup, measure, **model_kw)
@@ -455,22 +463,30 @@ def bench_transformer_lm(batch=8, seq_len=1024, vocab=32768, num_layers=12,
 
 # ------------------------------------------------------------ long context --
 def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
-                           (1, 8192, True), (1, 16384, True)),
+                           (1, 8192, True), (1, 16384, True),
+                           (1, 32768, True), (1, 65536, True, 8)),
                   vocab=32768, num_layers=12, d_model=768, num_heads=12,
                   warmup=3, measure=20):
     """Single-chip long-context rows (docs/PERF.md table): the 136M LM at
-    (batch, seq, remat) configs — flash attention keeps attention O(T),
-    remat + dots_with_no_batch_dims_saveable bounds block residuals.
-    Opt-in mode (``python bench.py longctx``): ~4 large compiles.
+    (batch, seq, remat[, head_chunks]) configs — flash attention keeps
+    attention O(T), remat + dots_with_no_batch_dims_saveable bounds block
+    residuals, and the T=65,536 row adds compile(head_chunks=8): the
+    (T, vocab) logits (4.3 GB bf16, twice that with the cotangent) never
+    materialize, which is what makes 64k context fit one 16 GB chip.
+    Opt-in mode (``python bench.py longctx``): ~6 large compiles.
     """
     rows = []
-    for batch, seq_len, remat in configs:
+    for cfg in configs:
+        batch, seq_len, remat = cfg[0], cfg[1], cfg[2]
+        head_chunks = cfg[3] if len(cfg) > 3 else None
         kw = {}
         if remat:
             kw = dict(
                 remat=True,
                 remat_policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
+        if head_chunks:
+            kw["head_chunks"] = head_chunks
         model, sps, win = _lm_bench_run(batch, seq_len, vocab, num_layers,
                                         d_model, num_heads, warmup, measure,
                                         metrics=(), **kw)
@@ -480,7 +496,8 @@ def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
         tflops = sps * 3.0 * fwd_per_token * tokens / 1e12
         rows.append({
             "metric": f"lm_longctx_b{batch}_t{seq_len}"
-                      f"{'_remat' if remat else ''}",
+                      f"{'_remat' if remat else ''}"
+                      f"{f'_hc{head_chunks}' if head_chunks else ''}",
             "value": round(sps * tokens, 1),
             "unit": "tokens/s",
             "steps_per_sec": round(sps, 3),

@@ -323,30 +323,31 @@ class SSHLauncher:
         # while we block on a different worker (the "never a hang"
         # contract). Heartbeat marker lines update last_beat and are
         # filtered out of the captured output.
-        outs: List[Optional[str]] = [None] * len(procs)
+        # Per-worker line buffers are shared with the drain threads: the
+        # main thread can assemble partial output WITHOUT joining a thread
+        # that may be blocked forever on a pipe an orphaned remote child
+        # still holds open (closing our read end cannot unblock a reader
+        # parked inside the stream's lock — it would deadlock the closer).
+        bufs: List[List[str]] = [[] for _ in procs]
         last_beat: List[Optional[float]] = [None] * len(procs)
         pids: List[Optional[int]] = [None] * len(procs)
 
         def _drain(i, proc):
-            buf = []
-            try:
-                for line in proc.stdout:
-                    if line.startswith(PID_MARK):
-                        try:
-                            pids[i] = int(line[len(PID_MARK):].strip())
-                        except ValueError:
-                            pass
-                        continue
-                    if line.startswith(HEARTBEAT_MARK):
-                        last_beat[i] = time.time()
-                        continue
-                    buf.append(line)
-                    if last_beat[i] is not None:
-                        # Once armed, any output counts as liveness: a
-                        # worker busy printing logs is not hung.
-                        last_beat[i] = time.time()
-            finally:
-                outs[i] = "".join(buf)
+            for line in proc.stdout:
+                if line.startswith(PID_MARK):
+                    try:
+                        pids[i] = int(line[len(PID_MARK):].strip())
+                    except ValueError:
+                        pass
+                    continue
+                if line.startswith(HEARTBEAT_MARK):
+                    last_beat[i] = time.time()
+                    continue
+                bufs[i].append(line)
+                if last_beat[i] is not None:
+                    # Once armed, any output counts as liveness: a
+                    # worker busy printing logs is not hung.
+                    last_beat[i] = time.time()
 
         def _remote_kill(i):
             """Best-effort SIGKILL of the remote worker process itself:
@@ -411,22 +412,17 @@ class SSHLauncher:
                 break
             time.sleep(0.2)
         # Bounded drain joins ("never a hang"): a wrapper script or remote
-        # child that inherited stdout can hold the pipe open past the kill;
-        # close our read end to force EOF rather than blocking forever.
+        # child that inherited stdout can hold the pipe open past the kill.
+        # After the deadline the daemon drain threads are simply ABANDONED —
+        # every line they read so far is already in bufs, and they die with
+        # the process. (Closing the read end from here cannot unblock a
+        # reader and can deadlock on the stream lock instead.)
         join_deadline = time.time() + 30.0
         for t in drains:
             t.join(max(0.0, join_deadline - time.time()))
-        for t, p in zip(drains, procs):
-            if t.is_alive():
-                try:
-                    p.stdout.close()
-                except Exception:
-                    pass
-        for t in drains:
-            t.join(5.0)
         results = []
         for i, proc in enumerate(procs):
-            out = outs[i]
+            out = "".join(bufs[i])
             value = None
             for line in (out or "").splitlines():
                 if line.startswith(self.MARK):

@@ -122,6 +122,29 @@ class NameScope:
         return name
 
 
+def apply_layers(layers, params, state, x, *, train=False, rng=None):
+    """Apply a sequence of layers with Sequential's rng-split and state-
+    collection discipline. The SINGLE implementation of that discipline:
+    Sequential.apply delegates here, and the chunked-head training path
+    (training/model.py) applies a Sequential's body (all layers but the
+    head) through the same function, so the two can't drift."""
+    new_state: State = {}
+    n_rng = sum(1 for l in layers if getattr(l, "needs_rng", False))
+    rngs = iter(jax.random.split(rng, n_rng)) if (rng is not None and n_rng) else iter(())
+    for layer in layers:
+        layer_rng = next(rngs, None) if getattr(layer, "needs_rng", False) else None
+        x, s = layer.apply(
+            params.get(layer.name, {}),
+            state.get(layer.name, {}),
+            x,
+            train=train,
+            rng=layer_rng,
+        )
+        if s:
+            new_state[layer.name] = s
+    return x, new_state
+
+
 class Sequential(Layer):
     """Linear stack of layers; itself a Layer, so stacks compose.
 
@@ -175,21 +198,9 @@ class Sequential(Layer):
         return hints
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        new_state: State = {}
-        n_rng = sum(1 for l in self.layers if getattr(l, "needs_rng", False))
-        rngs = iter(jax.random.split(rng, n_rng)) if (rng is not None and n_rng) else iter(())
-        for layer in self.layers:
-            layer_rng = next(rngs, None) if getattr(layer, "needs_rng", False) else None
-            x, s = layer.apply(
-                params.get(layer.name, {}),
-                state.get(layer.name, {}),
-                x,
-                train=train,
-                rng=layer_rng,
-            )
-            if s:
-                new_state[layer.name] = s
-        return x, new_state
+        return apply_layers(
+            self.layers, params, state, x, train=train, rng=rng
+        )
 
     def init_cache(self, params, batch, max_len, dtype):
         caches = {}

@@ -339,25 +339,26 @@ class ReduceLROnPlateau(Callback):
 class TensorBoard(Callback):
     """Write per-epoch scalars (loss, metrics, val_*) as TensorBoard event
     files, chief-only. Uses the installed TensorFlow's summary writer
-    lazily — the framework itself has no TF dependency; constructing the
-    callback without TF raises with a clear message."""
+    lazily — the framework itself has no TF dependency. The TF import is
+    checked on the CHIEF at on_train_begin, not at construction: non-chief
+    gang workers never write events, so a worker host without TF must not
+    crash just for constructing the callback (ADVICE r4)."""
 
     def __init__(self, log_dir):
         self.log_dir = str(log_dir)
         self._writer = None
+
+    def on_train_begin(self, model):
+        if jax.process_index() != 0:
+            return
         try:
-            import tensorflow as tf  # noqa: F401
+            import tensorflow as tf
         except ImportError as e:  # pragma: no cover
             raise ImportError(
                 "callbacks.TensorBoard needs the tensorflow package for "
                 "event-file writing (CSVLogger is the dependency-free "
                 "alternative)"
             ) from e
-
-    def on_train_begin(self, model):
-        if jax.process_index() != 0:
-            return
-        import tensorflow as tf
 
         self._writer = tf.summary.create_file_writer(self.log_dir)
 

@@ -33,7 +33,7 @@ import numpy as np
 import optax
 
 from .. import optim
-from ..nn.core import Layer
+from ..nn.core import Layer, apply_layers as _apply_layers
 from ..ops import losses as losses_lib
 from ..ops import metrics as metrics_lib
 from ..parallel.strategy import SingleDevice, Strategy, current_strategy
@@ -42,6 +42,19 @@ from ..utils import logging as dlog
 from ..utils.tree import tree_size
 from .progress import ProgressLine
 from .history import History
+
+
+def _split_head(module):
+    """(body_layers, head_layer) of a Sequential — the head is the final
+    layer, which the chunked-loss path applies per token chunk."""
+    layers = getattr(module, "layers", None)
+    if not layers or len(layers) < 2:
+        raise ValueError(
+            "head_chunks needs a Sequential module with >= 2 layers "
+            "(body + a tokenwise head as the LAST layer); got "
+            f"{type(module).__name__}"
+        )
+    return layers[:-1], layers[-1]
 
 
 def _aux_loss_sum(state):
@@ -107,6 +120,7 @@ class Model:
         self.compiled = False
         self.input_shape: Optional[Tuple[int, ...]] = None
         self.step = 0  # global optimizer step (checkpoint/resume cursor)
+        self.head_chunks = None  # compile(head_chunks=C): chunked head-loss
         self.stop_training = False  # callbacks (EarlyStopping) set this
         self._resumed_step = None  # set by a restoring ModelCheckpoint
         self._param_hints = {}  # TP role tree, populated by build()
@@ -144,9 +158,24 @@ class Model:
         metrics: Iterable = ("accuracy",),
         grad_clip: Optional[float] = None,
         gradient_accumulation_steps: Optional[int] = None,
+        head_chunks: Optional[int] = None,
         **optimizer_kwargs,
     ):
-        """``grad_clip``: global-norm gradient clipping applied before the
+        """``head_chunks=C``: fused chunked head-loss for token models.
+        The module's FINAL layer (the vocab head) and the loss are applied
+        over C chunks of the flattened token axis inside a rematerialized
+        ``lax.scan`` — the full (tokens, vocab) logits tensor never
+        materializes, in forward OR backward. This is the standard
+        long-context memory lever for big-vocab LMs: at T=65k, V=32k the
+        logits alone are 4.3 GB in bf16 (plus the same again for their
+        cotangent), which is exactly what a 16 GB chip cannot afford next
+        to params and activations. Costs one extra head forward per step
+        (the scan recompute). Requires a Sequential whose last layer is a
+        stateless tokenwise map ((..., D) -> (..., V), e.g. Dense) and
+        metrics with the standard (sum, count) protocol. predict() still
+        materializes full logits — slice or chunk calls at extreme T.
+
+        ``grad_clip``: global-norm gradient clipping applied before the
         optimizer update (optax.clip_by_global_norm); the norm reduction
         happens inside the jitted step, so under data parallelism it clips
         the *global* (all-reduced) gradient, not per-replica shards.
@@ -180,6 +209,13 @@ class Model:
                 self.tx = optax.MultiSteps(self.tx, every_k_schedule=int(n))
         self.loss_fn = losses_lib.get(loss)
         self.metric_fns = [(metrics_lib.name_of(m), metrics_lib.get(m)) for m in metrics]
+        if head_chunks is not None:
+            if not isinstance(head_chunks, (int, np.integer)) or head_chunks < 1:
+                raise ValueError(
+                    f"head_chunks must be an integer >= 1, got {head_chunks!r}"
+                )
+            _split_head(self.module)  # fail fast on unsuitable modules
+        self.head_chunks = int(head_chunks) if head_chunks else None
         self.compiled = True
         self._train_step = self._eval_step = None
         if self.built:
@@ -220,6 +256,8 @@ class Model:
     def _get_train_step(self):
         if self._train_step is not None:
             return self._train_step
+        if self.head_chunks and self.head_chunks > 1:
+            return self._get_chunked_train_step()
         module, tx, loss_fn = self.module, self.tx, self.loss_fn
         metric_fns = tuple(self.metric_fns)
 
@@ -245,6 +283,116 @@ class Model:
         self._train_step = self._scoped(jax.jit(step, donate_argnums=(0, 1, 2)))
         return self._train_step
 
+    def _chunked_head_scan(self, params, state, h, y, weights, train):
+        """Shared by the chunked train and eval paths: apply the head +
+        loss (+ sum-count metrics) over ``head_chunks`` chunks of the
+        flattened token axis under jax.checkpoint, so no more than one
+        chunk of logits is ever live — forward or backward.
+
+        ``weights``: per-token validity weights (None during training,
+        where every token counts). Returns (loss_sum, valid_count,
+        {metric: (sum, count)}).
+        """
+        import jax.lax as lax
+
+        C = self.head_chunks
+        loss_fn = self.loss_fn
+        metric_fns = tuple(self.metric_fns)
+        per_ex = losses_lib.get_per_example(loss_fn)
+        _, head = _split_head(self.module)
+        if state.get(head.name):
+            raise ValueError(
+                "head_chunks requires a STATELESS head layer; "
+                f"{head.name!r} carries state"
+            )
+        if h.ndim < 2:
+            raise ValueError(
+                f"head_chunks expects token activations (..., D); got "
+                f"shape {h.shape}"
+            )
+        d = h.shape[-1]
+        n_tok = int(np.prod(h.shape[:-1]))
+        if n_tok % C:
+            raise ValueError(
+                f"head_chunks={C} must divide the token count {n_tok} "
+                f"(= batch x seq)"
+            )
+        hf = h.reshape(C, n_tok // C, d)
+        yf = y.reshape(C, n_tok // C)
+        if weights is None:
+            wf = jnp.ones((C, n_tok // C), jnp.float32)
+        else:
+            wf = weights.reshape(C, n_tok // C).astype(jnp.float32)
+        head_params = params.get(head.name, {})
+
+        def chunk(carry, hyw):
+            h_i, y_i, w_i = hyw
+            logits_i, _ = head.apply(head_params, {}, h_i, train=train)
+            if per_ex is not None:
+                elems = per_ex(logits_i, y_i)
+                lsum = jnp.sum(elems * w_i.astype(elems.dtype))
+            else:
+                # Custom loss without a per-example form: whole-chunk mean
+                # weighted by the chunk's valid count (exact when unpadded).
+                lsum = loss_fn(logits_i, y_i) * jnp.sum(w_i)
+            msums = []
+            for name, fn in metric_fns:
+                scores = metrics_lib.per_example(fn)
+                if scores is not None:
+                    s_elems = scores(logits_i, y_i)
+                    msums.append((jnp.sum(s_elems * w_i.astype(s_elems.dtype)),
+                                  jnp.sum(w_i)))
+                else:
+                    # No per-example form: rescale the chunk's (sum, count)
+                    # by its valid-token weight, mirroring the plain eval
+                    # step's mask treatment (exact when unpadded).
+                    s, c = fn(logits_i, y_i)
+                    w_sum = jnp.sum(w_i)
+                    msums.append((s * w_sum / jnp.maximum(c, 1.0), w_sum))
+            loss_c, m_c = carry
+            m_new = tuple(
+                (a + jnp.float32(s), b + jnp.float32(c))
+                for (a, b), (s, c) in zip(m_c, msums)
+            )
+            return (loss_c + jnp.float32(lsum), m_new), None
+
+        init = (
+            jnp.float32(0.0),
+            tuple((jnp.float32(0.0), jnp.float32(0.0)) for _ in metric_fns),
+        )
+        (loss_sum, msums), _ = lax.scan(
+            jax.checkpoint(chunk), init, (hf, yf, wf)
+        )
+        mvals = {name: m for (name, _), m in zip(metric_fns, msums)}
+        return loss_sum, jnp.sum(wf), mvals
+
+    def _get_chunked_train_step(self):
+        """Train step for compile(head_chunks=C): body applies once, the
+        head + loss run chunk-by-chunk (see _chunked_head_scan)."""
+        module, tx = self.module, self.tx
+        body_layers, _ = _split_head(module)
+
+        def step(params, state, opt_state, x, y, rng):
+            def loss_f(p):
+                h, new_state = _apply_layers(
+                    body_layers, p, state, x, train=True, rng=rng
+                )
+                loss_sum, n_tok, mvals = self._chunked_head_scan(
+                    p, state, h, y, None, train=True
+                )
+                loss = loss_sum / n_tok + _aux_loss_sum(new_state)
+                return loss, (new_state, mvals)
+
+            (loss, (new_state, mvals)), grads = jax.value_and_grad(
+                loss_f, has_aux=True
+            )(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_state, new_opt, loss, mvals
+
+        self._train_step = self._scoped(jax.jit(step, donate_argnums=(0, 1, 2)))
+        return self._train_step
+
     def _scoped(self, jitted):
         """Run the jitted fn with this model's strategy as the ambient
         strategy: jit traces on first call, and trace-time code (e.g.
@@ -261,6 +409,8 @@ class Model:
     def _get_eval_step(self):
         if self._eval_step is not None:
             return self._eval_step
+        if self.head_chunks and self.head_chunks > 1:
+            return self._get_chunked_eval_step()
         module, loss_fn = self.module, self.loss_fn
         metric_fns = tuple(self.metric_fns)
         per_ex = losses_lib.get_per_example(self.loss_fn)
@@ -301,6 +451,29 @@ class Model:
                     s, c = fn(logits, y)
                     ex = jnp.sum(mask)
                     msums[name] = (s * ex / jnp.maximum(c, 1.0), ex)
+            return loss_sum, valid, msums
+
+        self._eval_step = self._scoped(jax.jit(step))
+        return self._eval_step
+
+    def _get_chunked_eval_step(self):
+        """Eval step for compile(head_chunks=C): same masked (sum, valid)
+        contract as the plain step, with the head + loss + metrics run per
+        token chunk so full logits never materialize."""
+        body_layers, _ = _split_head(self.module)
+
+        def step(params, state, x, y, mask):
+            h, new_state = _apply_layers(
+                body_layers, params, state, x, train=False, rng=None
+            )
+            # Per-example mask -> per-token weights (same broadcast the
+            # plain step applies to per-element losses).
+            m = mask.reshape(mask.shape + (1,) * (y.ndim - 1))
+            w = jnp.broadcast_to(m, y.shape)
+            loss_sum, valid, msums = self._chunked_head_scan(
+                params, state, h, y, w, train=False
+            )
+            loss_sum = loss_sum + _aux_loss_sum(new_state) * valid
             return loss_sum, valid, msums
 
         self._eval_step = self._scoped(jax.jit(step))

