@@ -20,7 +20,9 @@ Dense(...)])``.
 
 from __future__ import annotations
 
+import contextlib
 import re
+import threading
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -120,6 +122,32 @@ class NameScope:
         name = base if n == 0 else f"{base}_{n}"
         self._used.add(name)
         return name
+
+
+_AMBIENT_WEIGHTS = threading.local()
+
+
+@contextlib.contextmanager
+def eval_sample_weights(weights):
+    """Trace-time ambient per-EXAMPLE validity weights (shape (B,)).
+
+    The eval step pads its final batch to keep shapes static; layers whose
+    statistics span the batch (MoE routing: load-balance aux loss,
+    capacity competition) would otherwise count the pad rows. The eval
+    steps wrap ``module.apply`` in this context and such layers read
+    ``current_sample_weights()`` during tracing — the weights are a traced
+    array, so they become a real input of the compiled step. Training
+    never sets this (fit never pads), so the train graph is unchanged."""
+    prev = getattr(_AMBIENT_WEIGHTS, "value", None)
+    _AMBIENT_WEIGHTS.value = weights
+    try:
+        yield
+    finally:
+        _AMBIENT_WEIGHTS.value = prev
+
+
+def current_sample_weights():
+    return getattr(_AMBIENT_WEIGHTS, "value", None)
 
 
 def apply_layers(layers, params, state, x, *, train=False, rng=None):

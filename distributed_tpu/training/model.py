@@ -33,7 +33,11 @@ import numpy as np
 import optax
 
 from .. import optim
-from ..nn.core import Layer, apply_layers as _apply_layers
+from ..nn.core import (
+    Layer,
+    apply_layers as _apply_layers,
+    eval_sample_weights as _eval_sample_weights,
+)
 from ..ops import losses as losses_lib
 from ..ops import metrics as metrics_lib
 from ..parallel.strategy import SingleDevice, Strategy, current_strategy
@@ -416,7 +420,12 @@ class Model:
         per_ex = losses_lib.get_per_example(self.loss_fn)
 
         def step(params, state, x, y, mask):
-            logits, new_state = module.apply(params, state, x, train=False)
+            # Publish per-example validity to batch-statistic layers (MoE
+            # routing) so pad rows neither route nor bias aux losses.
+            with _eval_sample_weights(mask):
+                logits, new_state = module.apply(
+                    params, state, x, train=False
+                )
             # Token-level models have per-element losses of shape y.shape
             # (e.g. (B, T) for an LM); the pad mask is per-example (B,).
             # Broadcast it to the label rank and count *elements*, so the
@@ -437,8 +446,8 @@ class Model:
                 valid = jnp.sum(mask) * (y.size / y.shape[0])
                 loss_sum = loss_fn(logits, y) * valid
             # Keep evaluate() measuring the trained objective: auxiliary
-            # losses (MoE load balance) join here too. (On a padded final
-            # batch the aux term sees the pad rows — a small approximation.)
+            # losses (MoE load balance) join here too, computed over valid
+            # rows only (eval_sample_weights above excludes batch pads).
             loss_sum = loss_sum + _aux_loss_sum(new_state) * valid
             msums = {}
             for name, fn in metric_fns:
@@ -463,9 +472,10 @@ class Model:
         body_layers, _ = _split_head(self.module)
 
         def step(params, state, x, y, mask):
-            h, new_state = _apply_layers(
-                body_layers, params, state, x, train=False, rng=None
-            )
+            with _eval_sample_weights(mask):
+                h, new_state = _apply_layers(
+                    body_layers, params, state, x, train=False, rng=None
+                )
             # Per-example mask -> per-token weights (same broadcast the
             # plain step applies to per-element losses).
             m = mask.reshape(mask.shape + (1,) * (y.ndim - 1))
