@@ -159,8 +159,11 @@ class MoE(Layer):
         # consuming expert capacity ahead of later valid rows.
         sample_w = core.current_sample_weights()
         if sample_w is not None:
+            # Binarize: the dispatch position math (cumsum over one-hot
+            # choices) requires 0/1 validity — a fractional weight would
+            # make slot positions non-integral and alias buffer slots.
             per_tok = jnp.broadcast_to(
-                sample_w.astype(jnp.float32)[:, None], (b, t)
+                (sample_w > 0).astype(jnp.float32)[:, None], (b, t)
             ).reshape(n)
             if n_pad != n:
                 per_tok = jnp.concatenate(

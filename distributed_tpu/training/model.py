@@ -421,8 +421,18 @@ class Model:
 
         def step(params, state, x, y, mask):
             # Publish per-example validity to batch-statistic layers (MoE
-            # routing) so pad rows neither route nor bias aux losses.
-            with _eval_sample_weights(mask):
+            # routing) so pad rows neither route nor bias aux losses —
+            # but only when the loss can ALSO mask per element: a custom
+            # whole-batch-mean loss would average the zeroed-out pad
+            # outputs, a worse approximation than letting the pad clones
+            # route normally (they are copies of the last real row).
+            import contextlib
+
+            weights_ctx = (
+                _eval_sample_weights(mask) if per_ex is not None
+                else contextlib.nullcontext()
+            )
+            with weights_ctx:
                 logits, new_state = module.apply(
                     params, state, x, train=False
                 )
@@ -472,7 +482,16 @@ class Model:
         body_layers, _ = _split_head(self.module)
 
         def step(params, state, x, y, mask):
-            with _eval_sample_weights(mask):
+            # Same conditional as the plain eval step: weights only when
+            # the loss can mask per element (see _get_eval_step).
+            import contextlib
+
+            weights_ctx = (
+                _eval_sample_weights(mask)
+                if losses_lib.get_per_example(self.loss_fn) is not None
+                else contextlib.nullcontext()
+            )
+            with weights_ctx:
                 h, new_state = _apply_layers(
                     body_layers, params, state, x, train=False, rng=None
                 )
