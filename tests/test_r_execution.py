@@ -294,6 +294,49 @@ def test_spark_barrier_example_executes(tmp_path, monkeypatch):
     monkeypatch.delenv("DTPU_CONFIG", raising=False)
 
 
+def test_every_small_r_export_executes(tmp_path, monkeypatch):
+    """Sweep the exported wrappers the examples don't touch, so EVERY
+    exported R function's body has executed in CI (the examples cover the
+    training flow; this covers the rest). TensorBoard is exercised for
+    its construction path (TF import happens chief-side at train begin)."""
+    monkeypatch.chdir(tmp_path)
+    interp = make_interp()
+    interp.run_source("""
+    library(distributedtpu)
+    v <- dtpu_version()
+    install_distributed_tpu()           # reticulate::py_install is stubbed
+    s1 <- single_device_strategy()
+    s2 <- data_parallel_strategy()
+    n <- num_replicas_in_sync(s2)
+    cnn <- cifar_cnn(10L)
+    fm <- dataset_fashion_mnist()
+    cf <- dataset_cifar10(normalize = FALSE)
+    m <- dtpu_model(cifar_cnn(10L))
+    m %>% compile(optimizer = "sgd", learning_rate = 0.01,
+                  loss = "sparse_categorical_crossentropy")
+    m$build(c(32L, 32L, 3L))
+    summary_model(m)
+    cb1 <- model_checkpoint_callback("ckpts", save_freq = "epoch",
+                                     keep = 2L, restore = FALSE)
+    cb2 <- early_stopping_callback(monitor = "loss", patience = 2L)
+    cb3 <- reduce_lr_on_plateau_callback(factor = 0.5, patience = 1L)
+    cb4 <- tensorboard_callback("tb")
+    """)
+    assert isinstance(_scalar(interp.global_env.lookup("v")), str)
+    assert _scalar(interp.global_env.lookup("n")) == 8  # 8-device sim
+    fm = interp.global_env.lookup("fm")
+    assert fm.names == ["train", "test"]
+    cf = interp.global_env.lookup("cf")
+    x = cf.get("train").get("x")
+    # normalize=FALSE marshals back as an INTEGER array (uint8 -> int32)
+    from reticulate_sim import RArray
+
+    assert isinstance(x, RArray) and x.kind == "integer"
+    for name in ("cb1", "cb2", "cb3", "cb4"):
+        cb = interp.global_env.lookup(name)
+        assert cb.__class__.__name__ == "RProxy", name
+
+
 # ------------------------------------------------------- interpreter unit --
 @pytest.mark.smoke
 def test_pipe_body_executes_not_special_cased():
