@@ -141,6 +141,33 @@ def test_chunked_head_generate_unaffected():
     np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
 
 
+def test_chunked_head_composes_with_accumulation_and_clip():
+    """head_chunks x gradient_accumulation_steps x grad_clip: the chunked
+    loss feeds the same optax pipeline (MultiSteps wrapping clip), so the
+    composed run must match the plain step's composed run."""
+    x, y = _data(16)
+
+    def make(head_chunks):
+        m = dtpu.Model(dtpu.models.transformer_lm(
+            64, num_layers=2, d_model=16, num_heads=2, max_len=32))
+        m.compile(optimizer=dtpu.optim.SGD(0.1),
+                  loss="sparse_categorical_crossentropy", metrics=[],
+                  grad_clip=1.0, gradient_accumulation_steps=2,
+                  head_chunks=head_chunks)
+        m.build((32,))
+        return m
+
+    ma, mb = make(None), make(4)
+    ha = ma.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+    hb = mb.fit(x, y, batch_size=8, epochs=2, verbose=0, seed=0)
+    np.testing.assert_allclose(ha.history["loss"], hb.history["loss"],
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ma.params),
+                    jax.tree_util.tree_leaves(mb.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_chunked_head_with_pallas_xent_loss():
     """The bench's loss (Pallas fused xent, interpret mode on CPU) rides
     the same chunked path."""
