@@ -138,6 +138,86 @@ def bench_mnist(global_batch=GLOBAL_BATCH, warmup=10, measure=100):
     }
 
 
+# --------------------------------------------------------------- multi-step --
+def bench_multi_step(global_batch=None, ks=(1, 8, 32), measure_steps=192):
+    """Dispatch-overhead amortization curve: mnist_cnn trained through the
+    REAL ``fit()`` hot path at ``compile(steps_per_execution=K)`` for each
+    K. One fused dispatch runs K jitted steps (lax.scan over a
+    [K, batch, ...] super-batch, metrics accumulated on device), so the
+    per-step host work — batch placement, RNG folds, dispatch, the Python
+    loop — divides by K. Unlike the pre-staged headline window, this mode
+    times ``fit`` itself (epoch-end sync included): the host overhead the
+    feature amortizes IS the measurement target.
+
+    ``global_batch`` default: 256 (the reference shape) on accelerators,
+    where the tunneled transport's per-dispatch gap dominates small-model
+    steps; 2 on CPU, where JAX dispatch overhead is only ~1-2 ms and a
+    bigger batch buries it under conv compute (docs/PERF.md "Multi-step
+    execution")."""
+    from distributed_tpu.utils.profiler import StepTimer
+
+    if global_batch is None:
+        if jax.default_backend() != "cpu":
+            global_batch = GLOBAL_BATCH
+        else:
+            # 2 rows per replica: small enough that host dispatch overhead
+            # is a visible fraction of the CPU step.
+            n_dev = len(jax.devices())
+            global_batch = 2 * (n_dev if n_dev > 1 else 1)
+    x, y = dtpu.data.synthetic_images(512, (28, 28), 10, 0)
+    xb = x[..., None].astype(np.float32) / 255.0
+    yb = y.astype(np.int32)
+    rows = []
+    for k in ks:
+        strategy = _strategy()
+        with strategy.scope():
+            model = dtpu.Model(dtpu.models.mnist_cnn())
+            model.compile(
+                optimizer=dtpu.optim.SGD(0.001),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"],
+                steps_per_execution=k,
+            )
+        model.build((28, 28, 1))
+        steps = max(k, (measure_steps // k) * k)  # K-aligned window
+        timer = StepTimer(warmup=0)
+        cbs = [dtpu.callbacks.LambdaCallback(
+            on_epoch_begin=lambda m, e: timer.tick(0),  # (re)arm the clock
+            on_batch_end=lambda m, s, logs: timer.tick(steps=k),
+        )]
+        # Warmup epoch compiles the (possibly fused) step program.
+        model.fit(xb, yb, batch_size=global_batch, epochs=1,
+                  steps_per_epoch=k, verbose=0, seed=0)
+        rates = []
+        for _ in range(3):  # median-of-3, same protocol as every mode
+            timer.__init__(warmup=0)
+            model.fit(xb, yb, batch_size=global_batch, epochs=1,
+                      steps_per_epoch=steps, verbose=0, seed=0,
+                      callbacks=cbs)
+            # fit returned AFTER its epoch-end device_get: the clock (read
+            # now) covers dispatch AND compute of the whole window.
+            rates.append(timer.steps_per_sec)
+        rows.append({
+            "metric": (
+                f"mnist_cnn_multistep_k{k}_steps_per_sec_gb{global_batch}"
+            ),
+            "value": round(float(np.median(rates)), 2),
+            "unit": "steps/s",
+            "steps_per_execution": k,
+            "window_steps_per_sec": [round(r, 3) for r in rates],
+        })
+    out = dict(rows[0])
+    if len(rows) > 1:
+        out["rows"] = rows[1:]
+        if rows[0]["value"] > 0:
+            out["speedup_vs_k1"] = {
+                f"k{r['steps_per_execution']}":
+                    round(r["value"] / rows[0]["value"], 2)
+                for r in rows[1:]
+            }
+    return out
+
+
 # ------------------------------------------------------------- convergence --
 def _augment_shifts(x, y, shifts=(-2, -1, 0, 1, 2)):
     """Static shift augmentation (every (dr, dc) pair in ``shifts``^2):
@@ -516,8 +596,10 @@ def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
     return out
 
 
-def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
-    known = {"mnist", "convergence", "cifar", "resnet50", "lm", "longctx"}
+def main(modes=("mnist", "multistep", "convergence", "cifar", "resnet50",
+                "lm")):
+    known = {"mnist", "multistep", "convergence", "cifar", "resnet50", "lm",
+             "longctx"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -525,6 +607,8 @@ def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
         )
     headline = bench_mnist() if "mnist" in modes else None
     extra = []
+    if "multistep" in modes:
+        extra.append(bench_multi_step())
     if "convergence" in modes:
         extra.append(bench_convergence())
     if "cifar" in modes:
@@ -564,4 +648,4 @@ def main(modes=("mnist", "convergence", "cifar", "resnet50", "lm")):
 
 if __name__ == "__main__":
     main(tuple(sys.argv[1:])
-         or ("mnist", "convergence", "cifar", "resnet50", "lm"))
+         or ("mnist", "multistep", "convergence", "cifar", "resnet50", "lm"))

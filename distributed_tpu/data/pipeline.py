@@ -286,6 +286,13 @@ class Pipeline:
             raise ValueError("Pipeline is closed")
         xb = np.empty(self.batch_shape, np.float32)
         yb = np.empty((self.shard_rows,), np.int32)
+        self._fill(xb, yb)
+        return xb, yb
+
+    def _fill(self, xb: np.ndarray, yb: np.ndarray) -> None:
+        """Write the next batch into caller-provided buffers (contiguous
+        float32/int32 of batch_shape/(shard_rows,)) — the one batch-emit
+        implementation behind __next__ and next_k."""
         if self._handle is not None:
             step = self._lib.dtpu_pipeline_next(
                 self._handle,
@@ -295,7 +302,7 @@ class Pipeline:
             if step < 0:
                 raise StopIteration
             self.steps_emitted += 1
-            return xb, yb
+            return
         # Python fallback: identical pass/step semantics, numpy RNG shuffle.
         step = self._py_step
         self._py_step += 1
@@ -325,7 +332,26 @@ class Pipeline:
         else:
             yb[:] = 0
         self.steps_emitted += 1
-        return xb, yb
+
+    def next_k(self, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """The next ``k`` batches collated into stacked arrays of shape
+        ``(k,) + batch_shape`` / ``(k, shard_rows)`` — the super-batch
+        ``Model.fit`` transfers once under ``steps_per_execution=K``.
+
+        Each batch is written straight into its row of the output (the
+        native ring's producer buffer, or the Python path's gather, fills
+        the slice in place), so collation adds NO copy over ``k`` separate
+        ``__next__`` calls — it just moves the allocation up front."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"next_k needs k >= 1, got {k}")
+        if self._closed:
+            raise ValueError("Pipeline is closed")
+        xs = np.empty((k,) + self.batch_shape, np.float32)
+        ys = np.empty((k, self.shard_rows), np.int32)
+        for i in range(k):
+            self._fill(xs[i], ys[i])
+        return xs, ys
 
     def close(self):
         self._closed = True

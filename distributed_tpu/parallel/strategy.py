@@ -88,12 +88,19 @@ class Strategy:
         """Optimizer state placed consistently with the params."""
         return self.put_params(tx.init(params))
 
-    def put_batch(self, batch, per_host: bool = False):
+    def put_batch(self, batch, per_host: bool = False,
+                  stacked: bool = False):
         """Place a numpy batch onto devices. ``per_host=True`` means each
         process passes only ITS row-shard of the global batch (from e.g. a
         sharded ``data.Pipeline``); the shards assemble into one global
         array. Default is host-global input (every process passes the full
-        batch, the reference's feeding model)."""
+        batch, the reference's feeding model).
+
+        ``stacked=True``: the batch is a ``[K, batch, ...]`` super-batch
+        (``Model.compile(steps_per_execution=K)``) — the leading K axis is
+        replicated and the SECOND axis is the batch axis: every sharding
+        rule shifts one dimension right, so one transfer stages K steps of
+        data exactly as K separate ``put_batch`` calls would have."""
         if per_host:
             raise ValueError(
                 f"{type(self).__name__} cannot assemble per-host input "
@@ -113,7 +120,9 @@ class SingleDevice(Strategy):
     def __init__(self, device: Optional[jax.Device] = None):
         self.device = device or jax.devices()[0]
 
-    def put_batch(self, batch, per_host: bool = False):
+    def put_batch(self, batch, per_host: bool = False,
+                  stacked: bool = False):
+        # stacked super-batches need no special placement on one device.
         if per_host:
             raise ValueError(
                 "SingleDevice cannot assemble per-host input shards; a "
@@ -170,15 +179,20 @@ class DataParallel(Strategy):
         rep = NamedSharding(self.mesh, PartitionSpec())
         return jax.device_put(params, rep)
 
-    def put_batch(self, batch, per_host: bool = False):
+    def put_batch(self, batch, per_host: bool = False,
+                  stacked: bool = False):
         """Place a batch. Host-global by default (same array on every
         process, like the reference's full-dataset-everywhere feeding,
         /root/reference/README.md:369-373, with each process device-putting
         only its addressable slices). ``per_host=True``: each process passes
         only its own row-shard (rows [i*b/P, (i+1)*b/P) of the global batch,
         e.g. from ``data.Pipeline(shard=(i, P))``) and never materializes
-        the rest (SURVEY.md §7 hard parts)."""
+        the rest (SURVEY.md §7 hard parts). ``stacked=True``: leading-K
+        super-batch — K replicated, rows (dim 1) sharded (see
+        Strategy.put_batch)."""
         sh = self.batch_sharding()
+        if stacked:
+            sh = NamedSharding(self.mesh, PartitionSpec(None, self.axis))
         if per_host:
             return jax.tree_util.tree_map(
                 lambda x: jax.make_array_from_process_local_data(
@@ -215,25 +229,30 @@ def _check_pipe_divisible(params, hints, n: int, axis_name: str):
 
 
 def _put_batch_rows_seq(mesh: Mesh, rows, seq_axis: Optional[str], batch,
-                        per_host: bool):
+                        per_host: bool, stacked: bool = False):
     """Shared batch placement for strategies with row sharding and an
     optional sequence axis (DataSeqParallel, CompositeParallel): rows shard
     over ``rows`` (one axis name or a tuple), dim 1 over ``seq_axis`` when
-    present and the leaf has one."""
+    present and the leaf has one. ``stacked``: leading [K] multi-step dim,
+    replicated; every other rule shifts one dimension right."""
+    lead = (None,) if stacked else ()
+    row_dim = len(lead)
 
     def _put(x):
         x = np.asarray(x)
-        if seq_axis and x.ndim >= 2:
-            seq_len = x.shape[1]
+        if seq_axis and x.ndim >= row_dim + 2:
+            seq_len = x.shape[row_dim + 1]
             n_seq = int(mesh.shape[seq_axis])
             if seq_len % n_seq:
                 raise ValueError(
                     f"sequence length {seq_len} not divisible by "
                     f"{seq_axis}={n_seq} shards"
                 )
-            spec = PartitionSpec(rows, seq_axis, *([None] * (x.ndim - 2)))
+            spec = PartitionSpec(
+                *lead, rows, seq_axis, *([None] * (x.ndim - row_dim - 2))
+            )
         else:
-            spec = PartitionSpec(rows)
+            spec = PartitionSpec(*lead, rows)
         sh = NamedSharding(mesh, spec)
         if per_host:
             # A per-host row shard carries the FULL sequence, which only
@@ -241,7 +260,7 @@ def _put_batch_rows_seq(mesh: Mesh, rows, seq_axis: Optional[str], batch,
             # split crosses a process boundary.
             if (
                 seq_axis
-                and x.ndim >= 2
+                and x.ndim >= row_dim + 2
                 and _axis_spans_processes(mesh, seq_axis)
             ):
                 raise ValueError(
@@ -575,9 +594,10 @@ class DataSeqParallel(DataParallel):
         # Rank-dependent: applied per-leaf in put_batch.
         return NamedSharding(self.mesh, PartitionSpec(self.axis, self.seq_axis))
 
-    def put_batch(self, batch, per_host: bool = False):
+    def put_batch(self, batch, per_host: bool = False,
+                  stacked: bool = False):
         return _put_batch_rows_seq(
-            self.mesh, self.axis, self.seq_axis, batch, per_host
+            self.mesh, self.axis, self.seq_axis, batch, per_host, stacked
         )
 
 
@@ -719,10 +739,11 @@ class CompositeParallel(_HintedParallel):
     def batch_sharding(self):
         return NamedSharding(self.mesh, PartitionSpec(self._row_axes))
 
-    def put_batch(self, batch, per_host: bool = False):
+    def put_batch(self, batch, per_host: bool = False,
+                  stacked: bool = False):
         rows = self._row_axes if len(self._row_axes) > 1 else self._row_axes[0]
         return _put_batch_rows_seq(
-            self.mesh, rows, self.seq_axis, batch, per_host
+            self.mesh, rows, self.seq_axis, batch, per_host, stacked
         )
 
 

@@ -69,32 +69,45 @@ class ModelCheckpoint(Callback):
             raise ValueError("save_freq must be 'epoch' or a positive int")
         self.save_freq = save_freq
         self.restore = restore
+        self._last_bucket = 0  # save_freq bucket already saved (int freq)
 
     def on_train_begin(self, model):
-        if not self.restore:
-            return
-        has_ckpt = self.ckpt.latest_step() is not None
-        if jax.process_count() > 1:
-            # Collective decision: without a shared filesystem only the
-            # chief sees the (chief-only-written) checkpoints; every process
-            # must agree on whether to restore or the gang's collective
-            # schedules diverge. restore_into then broadcasts the values.
-            from jax.experimental import multihost_utils
+        if self.restore:
+            has_ckpt = self.ckpt.latest_step() is not None
+            if jax.process_count() > 1:
+                # Collective decision: without a shared filesystem only the
+                # chief sees the (chief-only-written) checkpoints; every
+                # process must agree on whether to restore or the gang's
+                # collective schedules diverge. restore_into then broadcasts
+                # the values.
+                from jax.experimental import multihost_utils
 
-            has_ckpt = bool(
-                multihost_utils.broadcast_one_to_all(np.bool_(has_ckpt))
-            )
-        if has_ckpt:
-            step = self.ckpt.restore_into(model)
-            # fit() reads this to skip already-completed epochs, so an
-            # identical relaunch completes to `epochs` instead of training
-            # `epochs` more (the crash-restart contract).
-            model._resumed_step = step
-            if jax.process_index() == 0:
-                dlog.info(f"ModelCheckpoint: resumed from step {step}")
+                has_ckpt = bool(
+                    multihost_utils.broadcast_one_to_all(np.bool_(has_ckpt))
+                )
+            if has_ckpt:
+                step = self.ckpt.restore_into(model)
+                # fit() reads this to skip already-completed epochs, so an
+                # identical relaunch completes to `epochs` instead of
+                # training `epochs` more (the crash-restart contract).
+                model._resumed_step = step
+                if jax.process_index() == 0:
+                    dlog.info(f"ModelCheckpoint: resumed from step {step}")
+        # Arm the int-save_freq cursor from the CURRENT step (0, a restored
+        # cursor, or a prior fit's progress): saves fire when the step
+        # counter CROSSES a save_freq boundary, not on `step % freq == 0` —
+        # under compile(steps_per_execution=K) the counter advances K at a
+        # time and exact multiples may never be observed. One step at a
+        # time the two rules trigger identically.
+        if isinstance(self.save_freq, int):
+            self._last_bucket = model.step // self.save_freq
 
     def on_batch_end(self, model, step, logs):
-        if isinstance(self.save_freq, int) and step % self.save_freq == 0:
+        if not isinstance(self.save_freq, int):
+            return
+        bucket = step // self.save_freq
+        if bucket > self._last_bucket:
+            self._last_bucket = bucket
             self.ckpt.save(model)
 
     def on_epoch_end(self, model, epoch, logs):

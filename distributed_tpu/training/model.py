@@ -125,11 +125,13 @@ class Model:
         self.input_shape: Optional[Tuple[int, ...]] = None
         self.step = 0  # global optimizer step (checkpoint/resume cursor)
         self.head_chunks = None  # compile(head_chunks=C): chunked head-loss
+        self.steps_per_execution = None  # compile(steps_per_execution=K)
         self.stop_training = False  # callbacks (EarlyStopping) set this
         self._resumed_step = None  # set by a restoring ModelCheckpoint
         self._param_hints = {}  # TP role tree, populated by build()
         self._seed = 0
         self._train_step = None
+        self._multi_train_step = None
         self._eval_step = None
         self._predict_step = None
         self._generate_fns = {}  # (shapes, sampling config) -> jitted scan (LRU)
@@ -163,6 +165,7 @@ class Model:
         grad_clip: Optional[float] = None,
         gradient_accumulation_steps: Optional[int] = None,
         head_chunks: Optional[int] = None,
+        steps_per_execution: Optional[int] = None,
         **optimizer_kwargs,
     ):
         """``head_chunks=C``: fused chunked head-loss for token models.
@@ -194,7 +197,22 @@ class Model:
         accumulator rides in the optimizer state) — but LEARNING-RATE
         SCHEDULES advance once per optimizer update, i.e. once per N fit
         steps: size a schedule in UPDATES (total_fit_steps / N), not fit
-        steps."""
+        steps.
+
+        ``steps_per_execution=K``: fuse K optimizer steps into ONE jitted
+        dispatch. ``fit`` stacks K host batches into a ``[K, batch, ...]``
+        super-batch, transfers it once, and runs a single ``lax.scan``
+        over the K slices with params/state/opt_state donated across the
+        whole dispatch; loss and metric (sum, count) accumulators stay on
+        device inside the scan. This amortizes per-step host overhead
+        (dispatch, placement, the per-step Python bookkeeping) over K
+        steps — the Keras ``steps_per_execution`` lever, and the cure for
+        host-bound small-model training (docs/PERF.md "Multi-step
+        execution"). Numerics match K=1 to float tolerance (same batch
+        order, same per-step RNG fold). Callbacks, the progress line, and
+        ``model.step`` advance at K-step granularity; validation is
+        unaffected (evaluate already syncs once per call). Composes with
+        ``head_chunks`` and ``gradient_accumulation_steps``."""
         self.tx = optim.get(optimizer, **optimizer_kwargs)
         if grad_clip is not None:
             if grad_clip <= 0:
@@ -220,8 +238,20 @@ class Model:
                 )
             _split_head(self.module)  # fail fast on unsuitable modules
         self.head_chunks = int(head_chunks) if head_chunks else None
+        if steps_per_execution is not None:
+            if (
+                not isinstance(steps_per_execution, (int, np.integer))
+                or steps_per_execution < 1
+            ):
+                raise ValueError(
+                    "steps_per_execution must be an integer >= 1, got "
+                    f"{steps_per_execution!r}"
+                )
+        self.steps_per_execution = (
+            int(steps_per_execution) if steps_per_execution else None
+        )
         self.compiled = True
-        self._train_step = self._eval_step = None
+        self._train_step = self._eval_step = self._multi_train_step = None
         if self.built:
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self
@@ -260,8 +290,19 @@ class Model:
     def _get_train_step(self):
         if self._train_step is not None:
             return self._train_step
+        self._train_step = self._scoped(
+            jax.jit(self._train_step_body(), donate_argnums=(0, 1, 2))
+        )
+        return self._train_step
+
+    def _train_step_body(self):
+        """The uncompiled single-step train body (plain or chunked-head):
+        ``(params, state, opt_state, x, y, rng) -> (params, state,
+        opt_state, loss, {metric: value})``. ``_get_train_step`` jits it
+        directly (the K=1 path, unchanged); ``_get_multi_step_train_step``
+        scans it K times inside one jit."""
         if self.head_chunks and self.head_chunks > 1:
-            return self._get_chunked_train_step()
+            return self._chunked_train_step_body()
         module, tx, loss_fn = self.module, self.tx, self.loss_fn
         metric_fns = tuple(self.metric_fns)
 
@@ -284,8 +325,7 @@ class Model:
             mvals = {name: fn(logits, y) for name, fn in metric_fns}
             return new_params, new_state, new_opt, loss, mvals
 
-        self._train_step = self._scoped(jax.jit(step, donate_argnums=(0, 1, 2)))
-        return self._train_step
+        return step
 
     def _chunked_head_scan(self, params, state, h, y, weights, train):
         """Shared by the chunked train and eval paths: apply the head +
@@ -370,7 +410,7 @@ class Model:
         mvals = {name: m for (name, _), m in zip(metric_fns, msums)}
         return loss_sum, jnp.sum(wf), mvals
 
-    def _get_chunked_train_step(self):
+    def _chunked_train_step_body(self):
         """Train step for compile(head_chunks=C): body applies once, the
         head + loss run chunk-by-chunk (see _chunked_head_scan)."""
         module, tx = self.module, self.tx
@@ -394,8 +434,76 @@ class Model:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_state, new_opt, loss, mvals
 
-        self._train_step = self._scoped(jax.jit(step, donate_argnums=(0, 1, 2)))
-        return self._train_step
+        return step
+
+    def _get_multi_step_train_step(self):
+        """Fused K-step dispatch for compile(steps_per_execution=K): one
+        jitted ``lax.scan`` over the leading axis of a ``[K, batch, ...]``
+        super-batch, running the SAME per-step body the K=1 path jits
+        (plain or chunked-head). Params/state/opt_state are donated once
+        per dispatch and thread through the scan carry; the loss and every
+        metric's (sum, count) accumulate on device — the host fetches
+        nothing until the epoch boundary. Per-step RNG is
+        ``fold_in(base_rng, step0 + i)``, bit-identical to the K=1 loop's
+        ``_step_rng`` at the same global step, so dropout/augmentation
+        draws match across K. K is read from the super-batch shape, so a
+        shorter remainder dispatch (epoch tail, resume) just compiles a
+        second program.
+
+        On CPU the scan is emitted FULLY UNROLLED (``unroll=K``): XLA:CPU
+        executes a while-loop body ~2x slower than the same ops outside it
+        (measured on the mnist_cnn step — loop-carry buffer copies defeat
+        the in-place reuse the straight-line program gets), which would
+        eat the entire dispatch saving. Accelerator backends keep the
+        rolled loop: the carry stays in place there and compile time stays
+        O(1) in K."""
+        if self._multi_train_step is not None:
+            return self._multi_train_step
+        body = self._train_step_body()
+        metric_names = tuple(name for name, _ in self.metric_fns)
+        unroll_full = self._device_platform() == "cpu"
+
+        def multi(params, state, opt_state, xs, ys, base_rng, step0):
+            def one(carry, slice_i):
+                params, state, opt_state, loss_sum, msums = carry
+                x, y, i = slice_i
+                rng = jax.random.fold_in(base_rng, step0 + i)
+                params, state, opt_state, loss, mvals = body(
+                    params, state, opt_state, x, y, rng
+                )
+                loss_sum = loss_sum + jnp.float32(loss)
+                msums = tuple(
+                    (s + jnp.float32(mvals[n][0]), c + jnp.float32(mvals[n][1]))
+                    for (s, c), n in zip(msums, metric_names)
+                )
+                return (params, state, opt_state, loss_sum, msums), None
+
+            init = (
+                params, state, opt_state, jnp.float32(0.0),
+                tuple(
+                    (jnp.float32(0.0), jnp.float32(0.0)) for _ in metric_names
+                ),
+            )
+            (params, state, opt_state, loss_sum, msums), _ = jax.lax.scan(
+                one, init, (xs, ys, jnp.arange(xs.shape[0])),
+                unroll=xs.shape[0] if unroll_full else 1,
+            )
+            mvals = {n: m for n, m in zip(metric_names, msums)}
+            return params, state, opt_state, loss_sum, mvals
+
+        self._multi_train_step = self._scoped(
+            jax.jit(multi, donate_argnums=(0, 1, 2))
+        )
+        return self._multi_train_step
+
+    def _device_platform(self) -> str:
+        """Platform ('cpu'/'tpu'/...) of the devices this model's strategy
+        places work on."""
+        mesh = getattr(self.strategy, "mesh", None)
+        if mesh is not None:
+            return mesh.devices.flat[0].platform
+        device = getattr(self.strategy, "device", None)
+        return (device or jax.devices()[0]).platform
 
     def _scoped(self, jitted):
         """Run the jitted fn with this model's strategy as the ambient
@@ -598,7 +706,8 @@ class Model:
                 "plain iterator (sources with steps_per_pass, e.g. "
                 "data.Pipeline, default to one pass)"
             )
-        step_fn = self._get_train_step()
+        multi_k = self.steps_per_execution or 1
+        step_fn = self._get_train_step() if multi_k == 1 else None
         history = History()
         is_chief = jax.process_index() == 0
         self.stop_training = False
@@ -616,6 +725,19 @@ class Model:
             def next_batch():
                 idx = next(stream)
                 return x[idx], y[idx]
+
+        def next_k_batches(k):
+            # K host batches collated into one [K, batch, ...] super-batch.
+            # A source with a native collator (data.Pipeline.next_k) fills
+            # the stacked buffer directly from its prefetch ring; anything
+            # else stacks k next_batch() results.
+            if y is None and hasattr(source, "next_k"):
+                return source.next_k(k)
+            pairs = [next_batch() for _ in range(k)]
+            return (
+                np.stack([p[0] for p in pairs]),
+                np.stack([p[1] for p in pairs]),
+            )
 
         # Crash-restart contract: when a callback restored a checkpoint and
         # the caller didn't pass initial_epoch, `epochs` is the *total*
@@ -664,38 +786,78 @@ class Model:
                 bar = ProgressLine(
                     epoch_steps, prefix=f"Epoch {epoch + 1}/{epochs}: "
                 )
-            for step_i in range(epoch_steps):
-                xb, yb = next_batch()
-                batch = self.strategy.put_batch(
-                    {"x": xb, "y": yb}, per_host=per_host
-                )
-                rng = self._step_rng()
-                self.params, self.state, self.opt_state, loss, mvals = step_fn(
-                    self.params, self.state, self.opt_state,
-                    batch["x"], batch["y"], rng,
-                )
-                self.step += 1
-                # Liveness beat for gang launchers (throttled no-op outside
-                # a gang): a worker blocked at a collective stops beating
-                # and the launcher's liveness_timeout gang-restarts it.
-                _gang_heartbeat()
-                losses.append(loss)
-                for name, _ in self.metric_fns:
-                    msums[name].append(mvals[name])
-                for cb in callbacks:
-                    cb.on_batch_end(self, self.step, {"loss": loss})
-                if bar is not None:
-                    bar.update(step_i + 1)
+            if multi_k == 1:
+                for step_i in range(epoch_steps):
+                    xb, yb = next_batch()
+                    batch = self.strategy.put_batch(
+                        {"x": xb, "y": yb}, per_host=per_host
+                    )
+                    rng = self._step_rng()
+                    self.params, self.state, self.opt_state, loss, mvals = \
+                        step_fn(
+                            self.params, self.state, self.opt_state,
+                            batch["x"], batch["y"], rng,
+                        )
+                    self.step += 1
+                    # Liveness beat for gang launchers (throttled no-op
+                    # outside a gang): a worker blocked at a collective stops
+                    # beating and the launcher's liveness_timeout
+                    # gang-restarts it.
+                    _gang_heartbeat()
+                    losses.append(loss)
+                    for name, _ in self.metric_fns:
+                        msums[name].append(mvals[name])
+                    for cb in callbacks:
+                        cb.on_batch_end(self, self.step, {"loss": loss})
+                    if bar is not None:
+                        bar.update(step_i + 1)
+            else:
+                # steps_per_execution=K: one fused dispatch per K steps.
+                # An epoch tail (or a mid-epoch resume) shorter than K runs
+                # as a smaller final dispatch, so no batch is ever skipped
+                # or replayed and resume needs no K-rounding.
+                multi_fn = self._get_multi_step_train_step()
+                base_rng = jax.random.PRNGKey(self._seed + 1)
+                done = 0
+                while done < epoch_steps:
+                    k = min(multi_k, epoch_steps - done)
+                    xs, ys = next_k_batches(k)
+                    batch = self.strategy.put_batch(
+                        {"x": xs, "y": ys}, per_host=per_host, stacked=True
+                    )
+                    (self.params, self.state, self.opt_state, loss_sum,
+                     mvals) = multi_fn(
+                        self.params, self.state, self.opt_state,
+                        batch["x"], batch["y"], base_rng, np.int32(self.step),
+                    )
+                    self.step += k
+                    done += k
+                    _gang_heartbeat()
+                    losses.append(loss_sum)  # on-device K-step sum
+                    for name, _ in self.metric_fns:
+                        msums[name].append(mvals[name])
+                    # Callbacks fire once per dispatch (K-step granularity);
+                    # the loss they see is the dispatch's per-step mean, as
+                    # a device scalar (reading it still costs a host sync).
+                    for cb in callbacks:
+                        cb.on_batch_end(self, self.step, {"loss": loss_sum / k})
+                    if bar is not None:
+                        bar.update(done)
             if bar is not None:
                 bar.close()
-            # One host sync per epoch.
-            logs = {"loss": float(np.mean(jax.device_get(losses)))}
+            # One host sync per epoch: the loss and every metric accumulator
+            # fetch in a SINGLE device_get. Under multi-step execution the
+            # list entries are already on-device K-step sums.
+            losses, fetched = jax.device_get((losses, msums))
+            if multi_k == 1:
+                logs = {"loss": float(np.mean(losses))}
+            else:
+                logs = {"loss": float(np.sum(losses) / epoch_steps)}
             # The device_get above is where async dispatch catches up with
             # real compute — beat again so the epoch-end window (sync +
             # validation + callbacks below) starts freshly armed.
             _gang_heartbeat()
-            for name, pairs in msums.items():
-                pairs = jax.device_get(pairs)
+            for name, pairs in fetched.items():
                 s = sum(p[0] for p in pairs)
                 c = sum(p[1] for p in pairs)
                 logs[name] = float(s / max(c, 1.0))
@@ -905,16 +1067,31 @@ class Model:
         n = x.shape[0]
         self.strategy.local_batch_size(batch_size)
         step_fn = self._get_predict_step()
-        outs = []
+        # Per-batch outputs stay DEVICE arrays: a blocking device_get after
+        # every dispatch used to serialize host and device (each batch
+        # waited out the previous one's transfer). A small sliding window
+        # keeps dispatch running ahead while bounding how many batches of
+        # logits are resident on device at once; everything left in the
+        # window is drained in one fetch at the end.
+        window = 16
+        pending = []  # not-yet-fetched device outputs, oldest first
+        fetched = []  # host arrays, in batch order
+        valids = []
         for start in range(0, n, batch_size):
             xb = x[start : start + batch_size]
-            valid = xb.shape[0]
-            if valid < batch_size:
-                xb = np.concatenate([xb, np.repeat(xb[-1:], batch_size - valid, axis=0)])
+            valids.append(xb.shape[0])
+            if xb.shape[0] < batch_size:
+                xb = np.concatenate(
+                    [xb, np.repeat(xb[-1:], batch_size - xb.shape[0], axis=0)]
+                )
             xb = self.strategy.put_batch({"x": xb})["x"]
-            out = np.asarray(jax.device_get(step_fn(self.params, self.state, xb)))
-            outs.append(out[:valid])
-        return np.concatenate(outs, axis=0)
+            pending.append(step_fn(self.params, self.state, xb))
+            if len(pending) >= window:
+                fetched.append(np.asarray(jax.device_get(pending.pop(0))))
+        fetched.extend(np.asarray(o) for o in jax.device_get(pending))
+        return np.concatenate(
+            [o[:v] for o, v in zip(fetched, valids)], axis=0
+        )
 
     # --------------------------------------------------------------- generate
     @staticmethod
@@ -1106,6 +1283,7 @@ class Model:
         # Placements (and possibly dtypes) changed: every cached compiled
         # step is stale, as is the memoized decode dtype (mirrors build()).
         self._train_step = self._eval_step = self._predict_step = None
+        self._multi_train_step = None
         self._decode_dtype = None
         self._generate_fns = {}
         if self.compiled:

@@ -50,15 +50,24 @@ class StepTimer:
         self.warmup = int(warmup)
         self.steps = 0
         self._t0 = None
+        self._measured_from = 0  # step count when the clock started
 
-    def tick(self):
-        self.steps += 1
-        if self.steps == self.warmup:
+    def tick(self, steps: int = 1):
+        """Count ``steps`` completed optimizer steps. Pass ``steps=K`` when
+        one call covers a fused multi-step dispatch
+        (``compile(steps_per_execution=K)``) so ``steps_per_sec`` reports
+        true per-STEP throughput, not per-dispatch. The warmup window
+        closes at the first tick that reaches it; steps beyond the
+        boundary inside that same tick are excluded from the rate along
+        with the warmup itself (the clock hasn't started yet)."""
+        self.steps += int(steps)
+        if self._t0 is None and self.steps >= self.warmup:
             self._t0 = time.perf_counter()
+            self._measured_from = self.steps
 
     @property
     def steps_per_sec(self) -> float:
-        counted = self.steps - self.warmup
+        counted = self.steps - self._measured_from
         if self._t0 is None or counted <= 0:
             return 0.0
         return counted / (time.perf_counter() - self._t0)
@@ -67,5 +76,8 @@ class StepTimer:
         rate = self.steps_per_sec
         if jax.process_index() == 0:
             dlog.event("step_rate", steps_per_sec=rate, steps=self.steps, **extra)
-            dlog.info(f"{rate:.2f} steps/s over {self.steps - self.warmup} steps")
+            dlog.info(
+                f"{rate:.2f} steps/s over "
+                f"{self.steps - self._measured_from} steps"
+            )
         return rate

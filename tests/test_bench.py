@@ -89,6 +89,7 @@ def test_bench_output_contract(monkeypatch, capsys):
         lambda **kw: {"metric": "m", "value": 1.0, "unit": "steps/s",
                       "vs_baseline": 2.0},
     )
+    monkeypatch.setattr(bench, "bench_multi_step", lambda **kw: {"metric": "k"})
     monkeypatch.setattr(bench, "bench_convergence", lambda **kw: {"metric": "c"})
     monkeypatch.setattr(bench, "bench_cifar", lambda **kw: {"metric": "f"})
     monkeypatch.setattr(bench, "bench_resnet50", lambda **kw: {"metric": "r"})
@@ -99,8 +100,19 @@ def test_bench_output_contract(monkeypatch, capsys):
     assert len(lines) == 1
     rec = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
-    assert [e["metric"] for e in rec["extra"]] == ["c", "f", "r", "t"]
+    assert [e["metric"] for e in rec["extra"]] == ["k", "c", "f", "r", "t"]
     assert "device" in rec
+
+
+def test_bench_multistep_smoke():
+    """The steps_per_execution curve: tiny window, K in {1, 2} — the real
+    K in {1, 8, 32} curve runs via `python bench.py multistep`."""
+    out = bench.bench_multi_step(global_batch=8, ks=(1, 2), measure_steps=4)
+    assert out["steps_per_execution"] == 1 and out["value"] > 0
+    (row2,) = out["rows"]
+    assert row2["steps_per_execution"] == 2 and row2["value"] > 0
+    assert "k2" in out["speedup_vs_k1"]
+    assert len(out["window_steps_per_sec"]) == 3
 
 
 def test_bench_cifar_smoke():
