@@ -26,7 +26,9 @@ XLA's cost model.
 Each mode is a function with size parameters so tests/test_bench.py can
 smoke-run the exact code path on CPU with tiny shapes. Besides the default
 modes, ``python bench.py longctx`` measures the long-context rows
-(docs/PERF.md table) — opt-in, large compiles.
+(docs/PERF.md table) — opt-in, large compiles — and ``python bench.py
+resilience`` measures supervisor heartbeat overhead and restart-to-first-
+step latency (docs/RESILIENCE.md) — opt-in, spawns worker subprocesses.
 """
 
 import json
@@ -541,6 +543,134 @@ def bench_transformer_lm(batch=32, seq_len=1024, vocab=32768, num_layers=12,
     return out
 
 
+# -------------------------------------------------------------- resilience --
+def bench_resilience(throttled_calls=1_000_000, beats=50_000,
+                     train_steps=8, kill_step=3, save_freq=2):
+    """Resilience subsystem cost: (a) heartbeat overhead at steady state —
+    the per-batch liveness publish Model.fit performs under a gang
+    launcher, measured both on its throttled fast path (the common case:
+    a monotonic-clock check) and per actual beat (file touch); (b)
+    restart-to-first-step latency — a supervised single-worker training
+    run is fault-injected (kill mid-epoch), and the event log's
+    timestamps give the wall-clock from failure detection to the
+    restarted worker's first optimizer step (process spawn + imports +
+    checkpoint restore + jit recompile; the supervisor's backoff is set
+    near zero so the number measures the machinery, not the policy).
+
+    Runs the worker on XLA:CPU regardless of the bench machine's chip —
+    the subsystem under test is the process lifecycle, not the matmuls.
+    """
+    import os
+    import tempfile
+    import textwrap
+    from pathlib import Path
+
+    from distributed_tpu.launch import core as launch_core
+    from distributed_tpu.resilience import RestartPolicy, Supervisor
+    from distributed_tpu.utils.events import EventLog
+
+    # -- (a) heartbeat cost ------------------------------------------------
+    tmp = Path(tempfile.mkdtemp(prefix="dtpu_bench_resil_"))
+    hb_file = tmp / "hb"
+    saved_env = os.environ.get(launch_core.HEARTBEAT_ENV)
+    os.environ[launch_core.HEARTBEAT_ENV] = str(hb_file)
+    try:
+        launch_core.heartbeat(min_interval=0.0)  # arm file + throttle state
+        t0 = time.perf_counter()
+        for _ in range(throttled_calls):
+            launch_core.heartbeat()  # default throttle: fast path
+        throttled_ns = (time.perf_counter() - t0) / throttled_calls * 1e9
+        t0 = time.perf_counter()
+        for _ in range(beats):
+            launch_core.heartbeat(min_interval=0.0)  # every call touches
+        beat_ns = (time.perf_counter() - t0) / beats * 1e9
+    finally:
+        if saved_env is None:
+            os.environ.pop(launch_core.HEARTBEAT_ENV, None)
+        else:
+            os.environ[launch_core.HEARTBEAT_ENV] = saved_env
+
+    # -- (b) restart-to-first-step latency ---------------------------------
+    worker = tmp / "worker.py"
+    worker.write_text(textwrap.dedent(
+        """
+        import os, sys
+        sys.path.insert(0, os.environ["BENCH_REPO"])
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import distributed_tpu as dtpu
+        from distributed_tpu.resilience import FaultInjector
+        from distributed_tpu.training.callbacks import (
+            LambdaCallback, ModelCheckpoint)
+        from distributed_tpu.utils import events
+
+        attempt = int(os.environ.get("DTPU_ATTEMPT", "1"))
+        x, y = dtpu.data.synthetic_images(256, (28, 28), 10, 0)
+        x = x[..., None].astype(np.float32) / 255.0
+        m = dtpu.Model(dtpu.models.mnist_cnn())
+        m.compile(optimizer=dtpu.optim.SGD(0.05), metrics=["accuracy"])
+        seen = []
+        def first_step(model, step, logs):
+            if not seen:
+                seen.append(step)
+                events.emit("first_step", attempt=attempt, step=int(step))
+        cbs = [ModelCheckpoint(os.environ["BENCH_CKPT"],
+                               save_freq=int(os.environ["BENCH_SAVE_FREQ"]),
+                               restore=True),
+               LambdaCallback(on_batch_end=first_step)]
+        fault = FaultInjector.from_env()
+        if fault is not None:
+            cbs.append(fault)
+        m.fit(x, y.astype(np.int32), batch_size=32, epochs=1,
+              steps_per_epoch=int(os.environ["BENCH_STEPS"]), verbose=0,
+              seed=0, callbacks=cbs)
+        """
+    ))
+    log = EventLog(tmp / "events.jsonl")
+    sup = Supervisor(
+        [sys.executable, str(worker)], 1,
+        policy=RestartPolicy(max_restarts=2, backoff=0.01, backoff_max=0.01),
+        checkpoint_dir=tmp / "ckpt",
+        event_log=log,
+        env_extra={
+            "BENCH_REPO": os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_CKPT": str(tmp / "ckpt"),
+            "BENCH_STEPS": str(train_steps),
+            "BENCH_SAVE_FREQ": str(save_freq),
+            "DTPU_FAULT": f"kill:at_step={kill_step}",
+            "DTPU_FAULT_MARKER": str(tmp / "fault_once"),
+        },
+    )
+    result = sup.run(timeout=600.0)
+    events = log.read()
+
+    def first(kind, **match):
+        for e in events:
+            if e["event"] == kind and all(e.get(k) == v
+                                          for k, v in match.items()):
+                return e
+        return None
+
+    fail_end = first("attempt_end", attempt=1)
+    resumed = first("first_step", attempt=2)
+    latency = (round(resumed["ts"] - fail_end["ts"], 3)
+               if (fail_end and resumed) else None)
+    return {
+        "metric": "resilience_restart_to_first_step_seconds",
+        "value": latency,
+        "unit": "s",
+        "ok": result.ok,
+        "attempts": result.attempts,
+        "restarts_used": result.restarts_used,
+        "heartbeat_throttled_ns_per_call": round(throttled_ns, 1),
+        "heartbeat_beat_ns_per_call": round(beat_ns, 1),
+        "note": "latency includes process spawn, imports, checkpoint "
+                "restore and jit recompile on XLA:CPU (backoff ~0)",
+    }
+
+
 # ------------------------------------------------------------ long context --
 def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
                            (1, 8192, True), (1, 16384, True),
@@ -599,7 +729,7 @@ def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
 def main(modes=("mnist", "multistep", "convergence", "cifar", "resnet50",
                 "lm")):
     known = {"mnist", "multistep", "convergence", "cifar", "resnet50", "lm",
-             "longctx"}
+             "longctx", "resilience"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -619,6 +749,9 @@ def main(modes=("mnist", "multistep", "convergence", "cifar", "resnet50",
         extra.append(bench_transformer_lm())
     if "longctx" in modes:
         extra.append(bench_longctx())
+    if "resilience" in modes:
+        # Opt-in (like longctx): spawns supervised worker subprocesses.
+        extra.append(bench_resilience())
     result = headline or extra.pop(0)
     if extra:
         result["extra"] = extra

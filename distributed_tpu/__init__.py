@@ -28,6 +28,7 @@ Quickstart (the reference's local->distributed 6-line-diff contract):
 from . import cluster, data, models, nn, ops, optim, parallel, utils
 from .checkpoint import Checkpointer, ShardedCheckpointer, export_hdf5, import_hdf5
 from .training import callbacks
+from . import resilience  # after training/checkpoint: builds on both
 from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
@@ -77,5 +78,6 @@ __all__ = [
     "cluster",
     "utils",
     "callbacks",
+    "resilience",
     "__version__",
 ]

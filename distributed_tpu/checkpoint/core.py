@@ -23,6 +23,7 @@ import json
 import os
 import re
 import tempfile
+import zipfile
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
@@ -30,6 +31,13 @@ import jax
 import numpy as np
 
 SEP = "/"
+
+# What a torn/garbage checkpoint file raises out of np.load/json meta decode:
+# truncated zips (BadZipFile/EOFError/OSError), non-zip garbage and bad
+# array headers (ValueError, which JSONDecodeError subclasses), and a
+# missing required key (KeyError). Anything else is a real bug and must
+# propagate.
+_CORRUPT_ERRORS = (OSError, ValueError, KeyError, EOFError, zipfile.BadZipFile)
 
 
 def _to_host(val) -> np.ndarray:
@@ -194,10 +202,19 @@ class Checkpointer:
     """Step-tagged training checkpoints with resume.
 
     Layout: ``dir/ckpt-<step>.npz`` holding params/state/opt_state and a meta
-    record (step, seed). ``restore_into(model)`` reloads the latest (or a
-    given step) and re-places arrays under the model's strategy, so a resumed
+    record (step, seed), plus a ``latest`` pointer file (JSON
+    ``{"step": N}``) written atomically (tmp + ``os.replace``) after every
+    completed save — a crash mid-save can leave a torn ``ckpt-N.npz.tmp``
+    at worst, never a truncated pointer or a half-written checkpoint under
+    the real name. ``restore_into(model)`` reloads the latest (or a given
+    step) and re-places arrays under the model's strategy, so a resumed
     run continues bit-identically on any mesh with the same replica count.
+    When the newest file is corrupt anyway (torn by the filesystem, or a
+    fault-injection test), auto-restore skips it and falls back to the
+    previous step instead of failing the relaunch.
     """
+
+    LATEST_NAME = "latest"
 
     def __init__(self, directory, keep: int = 3):
         self.directory = Path(directory)
@@ -216,9 +233,79 @@ class Checkpointer:
                 steps.append(int(m.group(1)))
         return sorted(steps)
 
+    # -------------------------------------------------------- latest pointer
+    def _write_latest_pointer(self, step: int):
+        payload = json.dumps({"step": int(step)})
+        self.directory.mkdir(parents=True, exist_ok=True)
+        _atomic_write(
+            self.directory / self.LATEST_NAME,
+            lambda tmp: Path(tmp).write_text(payload),
+        )
+
+    def _read_latest_pointer(self) -> Optional[int]:
+        try:
+            rec = json.loads((self.directory / self.LATEST_NAME).read_text())
+            return int(rec["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None  # absent/garbage pointer: the glob scan decides
+
     def latest_step(self) -> Optional[int]:
+        """Newest step on disk. The pointer is the fast path; the glob scan
+        both backstops a missing/corrupt pointer and wins when it is STALE
+        (a crash between the npz save and the pointer write leaves the
+        pointer one step behind a complete, atomically-renamed file)."""
         steps = self.all_steps()
+        ptr = self._read_latest_pointer()
+        if ptr is not None and self._path(ptr).exists():
+            return max(ptr, steps[-1]) if steps else ptr
         return steps[-1] if steps else None
+
+    # ------------------------------------------------------------- validity
+    def is_valid(self, step: int) -> bool:
+        """Cheap structural check: the file opens as a zip and its member
+        table reads. Does not decompress arrays (full validation is the
+        load itself, which restore retries downward on failure)."""
+        try:
+            with np.load(self._path(step), allow_pickle=False) as z:
+                z.files  # noqa: B018 — forces the zip directory read
+            return True
+        except _CORRUPT_ERRORS:
+            return False
+
+    def latest_valid_step(self) -> Optional[int]:
+        for step in reversed(self.all_steps()):
+            if self.is_valid(step):
+                return step
+        return None
+
+    def _load_latest_valid(self) -> Tuple[int, Any, dict]:
+        """(step, tree, meta) of the newest LOADABLE checkpoint: corrupt
+        files are skipped (warning + 'corrupt_checkpoint_skipped' event)
+        and the scan falls back to the previous step — a torn latest file
+        must cost one checkpoint interval of progress, not the run."""
+        from ..utils import events as events_lib
+        from ..utils import logging as dlog
+
+        steps = self.all_steps()
+        for step in reversed(steps):
+            try:
+                tree, meta = load_npz(self._path(step))
+                return step, tree, meta
+            except _CORRUPT_ERRORS as e:
+                dlog.warning(
+                    f"Checkpointer: skipping corrupt checkpoint "
+                    f"{self._path(step).name} ({type(e).__name__}: {e}); "
+                    "falling back to the previous step"
+                )
+                events_lib.emit(
+                    "corrupt_checkpoint_skipped", step=int(step),
+                    path=str(self._path(step)), error=str(e),
+                )
+        raise FileNotFoundError(
+            f"No loadable checkpoints in {self.directory} "
+            f"({len(steps)} candidate file(s), all corrupt)"
+            if steps else f"No checkpoints in {self.directory}"
+        )
 
     def save(self, model, step: Optional[int] = None) -> Path:
         step = model.step if step is None else step
@@ -235,6 +322,7 @@ class Checkpointer:
         path = save_npz(self._path(step), tree, meta)
         if _is_chief():
             self._gc()
+            self._write_latest_pointer(step)
         return path
 
     def _gc(self):
@@ -256,10 +344,14 @@ class Checkpointer:
         collective schedules in lockstep."""
         if jax.process_count() > 1:
             return self._restore_multihost(model, step)
-        step = self.latest_step() if step is None else step
         if step is None:
-            raise FileNotFoundError(f"No checkpoints in {self.directory}")
-        tree, meta = load_npz(self._path(step))
+            # Auto-restore scans down past corrupt files (a crash mid-save,
+            # torn storage) to the newest loadable step; an EXPLICIT step
+            # must load exactly that step or raise — silent substitution
+            # would hide the corruption from a caller who named the step.
+            step, tree, meta = self._load_latest_valid()
+        else:
+            tree, meta = load_npz(self._path(step))
         if not model.built:
             model.build(meta["input_shape"], seed=meta.get("seed", 0))
         model.params = model.strategy.put_params(
@@ -314,9 +406,20 @@ class Checkpointer:
         # Header broadcast first so every process agrees on BOTH the step
         # and the value-broadcast *structure* before any array collective —
         # a structure mismatch across processes would hang the gang.
-        local = self.latest_step() if step is None else step
+        tree = None
+        local = step
+        if chief:
+            if step is None:
+                # Same corrupt-skip scan as the single-host path, run on
+                # the chief BEFORE the header broadcast so every process
+                # agrees on the (possibly fallen-back) step.
+                try:
+                    local, tree, meta = self._load_latest_valid()
+                except FileNotFoundError:
+                    local = None
+            else:
+                tree, meta = load_npz(self._path(step))
         if chief and local is not None:
-            tree, meta = load_npz(self._path(local))
             ck_p = len(jax.tree_util.tree_leaves(tree["params"]))
             ck_s = len(jax.tree_util.tree_leaves(tree.get("state") or {}))
             ck_o = len(jax.tree_util.tree_leaves(tree.get("opt_state")))

@@ -9,6 +9,7 @@ programmatic TCP check the launcher runs before gang-start.
 from __future__ import annotations
 
 import socket
+import time
 from typing import Dict, List, Tuple
 
 
@@ -55,23 +56,51 @@ def free_ports(n: int) -> List[int]:
             s.close()
 
 
-def check_reachable(addr: str, timeout: float = 2.0) -> bool:
+def backoff_schedule(attempts: int, backoff: float = 0.5,
+                     backoff_max: float = 8.0) -> List[float]:
+    """Sleep lengths BETWEEN ``attempts`` tries: bounded exponential,
+    ``backoff * 2**i`` capped at ``backoff_max`` (len == attempts - 1).
+    Shared by the reachability retry below and unit-testable on its own."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    return [min(backoff * 2.0**i, backoff_max) for i in range(attempts - 1)]
+
+
+def check_reachable(addr: str, timeout: float = 2.0, attempts: int = 1,
+                    backoff: float = 0.5, backoff_max: float = 8.0,
+                    _sleep=time.sleep) -> bool:
     """TCP reachability to host:port (the programmatic 'ping', README.md:251).
 
     A connection *refusal* still means the host is up (nothing bound to the
     port yet — normal before gang-start); only DNS failure or a timeout /
-    network unreachability counts as down."""
+    network unreachability counts as down. Those failures are retried up to
+    ``attempts`` times with bounded exponential backoff (``backoff``,
+    doubling, capped at ``backoff_max``): a worker VM that is still booting
+    resolves/routes a few seconds late, and one slow host must delay
+    gang-start, not fail it. A positive answer returns immediately."""
     host, port = addr.rsplit(":", 1)
-    try:
-        with socket.create_connection((host, int(port)), timeout=timeout):
-            return True
-    except ConnectionRefusedError:
-        return True  # host answered; port simply not bound yet
-    except OSError:
-        return False
+    delays = backoff_schedule(attempts, backoff, backoff_max)
+    for i in range(attempts):
+        try:
+            with socket.create_connection((host, int(port)), timeout=timeout):
+                return True
+        except ConnectionRefusedError:
+            return True  # host answered; port simply not bound yet
+        except OSError:
+            if i < len(delays):
+                _sleep(delays[i])
+    return False
 
 
-def preflight(workers: List[str], timeout: float = 2.0) -> Dict[str, bool]:
+def preflight(workers: List[str], timeout: float = 2.0, attempts: int = 3,
+              backoff: float = 0.5, backoff_max: float = 8.0) -> Dict[str, bool]:
     """Reachability map for a worker list, run by the launcher before
-    gang-start (replaces the reference's manual `ping`, README.md:251)."""
-    return {w: check_reachable(w, timeout=timeout) for w in workers}
+    gang-start (replaces the reference's manual `ping`, README.md:251).
+    Retries each unreachable worker with bounded exponential backoff
+    (``attempts`` tries) so workers still booting pass the gang-start
+    check instead of failing on the first refused/unrouted probe."""
+    return {
+        w: check_reachable(w, timeout=timeout, attempts=attempts,
+                           backoff=backoff, backoff_max=backoff_max)
+        for w in workers
+    }

@@ -42,6 +42,17 @@ def main(argv=None):
                          "N times; pair with ModelCheckpoint(restore=True) "
                          "in the script so relaunches resume from the "
                          "latest checkpoint")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under resilience.Supervisor instead of the "
+                         "flat restart loop: exponential backoff between "
+                         "relaunches, preemption-aware budget (exit 75 "
+                         "restarts for free), structured event log")
+    ap.add_argument("--checkpoint-dir", type=str, default=None,
+                    help="(with --supervise) the run's checkpoint dir, for "
+                         "resume-state events and marker cleanup")
+    ap.add_argument("--event-log", type=str, default=None,
+                    help="(with --supervise) JSONL event log path; also "
+                         "exported to workers as DTPU_EVENT_LOG")
     ap.add_argument("script", type=str)
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -50,17 +61,43 @@ def main(argv=None):
     if args.hosts:
         kw = {"port": args.base_port} if args.base_port else {}
         launcher = core.SSHLauncher(args.hosts.split(","), **kw)
+        n = len(launcher.hosts)
+        run_kw = {"timeout": args.timeout,
+                  "liveness_timeout": args.liveness_timeout}
+    else:
+        launcher = core.LocalLauncher()
+        n = args.num_workers or 1
+        run_kw = {"timeout": args.timeout, "base_port": args.base_port,
+                  "liveness_timeout": args.liveness_timeout}
+
+    if args.supervise:
+        from ..resilience import RestartPolicy, Supervisor
+        from ..utils.events import EventLog
+
+        sup = Supervisor(
+            worker_argv, n, launcher=launcher,
+            policy=RestartPolicy(max_restarts=args.max_restarts or 3),
+            checkpoint_dir=args.checkpoint_dir,
+            event_log=EventLog(args.event_log) if args.event_log else None,
+            liveness_timeout=args.liveness_timeout,
+        )
+        base_port = run_kw.pop("base_port", None)
+        run_kw.pop("liveness_timeout", None)  # the Supervisor injects it
+        if base_port is not None:
+            run_kw["base_port"] = base_port
+        sup_result = sup.run(**run_kw)
+        results = sup_result.results
+        print(f"supervisor: attempts={sup_result.attempts} "
+              f"restarts={sup_result.restarts_used} "
+              f"preemptions={sup_result.preemptions}")
+    elif args.hosts:
         results = core.run_with_restart(
-            launcher, worker_argv, max_restarts=args.max_restarts,
-            timeout=args.timeout, liveness_timeout=args.liveness_timeout,
+            launcher, worker_argv, max_restarts=args.max_restarts, **run_kw
         )
     else:
-        n = args.num_workers or 1
         results = core.run_with_restart(
-            core.LocalLauncher(), worker_argv, n,
-            max_restarts=args.max_restarts,
-            timeout=args.timeout, base_port=args.base_port,
-            liveness_timeout=args.liveness_timeout,
+            launcher, worker_argv, n, max_restarts=args.max_restarts,
+            **run_kw
         )
 
     rows = [

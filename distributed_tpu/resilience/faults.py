@@ -1,0 +1,155 @@
+"""Fault injection: prove the resilience machinery works, on demand.
+
+A supervised runtime is only trustworthy if its failure paths are
+exercised — so fault injection is a first-class, shippable tool here
+(usable from tests AND ``bench.py resilience``), not test-local
+monkeypatching. :class:`FaultInjector` is a training callback that makes a
+worker fail in a chosen mode at a chosen step, once:
+
+- ``kill``: hard process death (``os._exit``) — the crash the launcher's
+  exit-code monitoring sees.
+- ``hang``: SIGSTOP the process — alive but frozen, the failure mode only
+  heartbeat liveness tracking can see.
+- ``slow_heartbeat``: the process stays schedulable but stops making
+  progress (a long in-step sleep), so heartbeats stall below the
+  launcher's ``liveness_timeout`` — hung-in-Python rather than
+  hung-in-kernel.
+- ``corrupt_checkpoint``: clobber the newest checkpoint file, then die —
+  exercising restore's fall-back-to-previous-step path.
+
+``once_marker`` (a file path) arms the fault for the FIRST attempt only:
+the restarted worker sees the marker and trains through — exactly the
+kill-once/recover-once shape every restart test needs. The supervisor
+exports ``DTPU_FAULT`` + ``DTPU_FAULT_MARKER`` so worker scripts can arm
+injection with ``FaultInjector.from_env()`` without plumbing arguments.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import time
+from pathlib import Path
+from typing import Optional
+
+from ..training.callbacks import Callback
+from ..utils import events as events_lib
+
+ENV_VAR = "DTPU_FAULT"
+MARKER_ENV_VAR = "DTPU_FAULT_MARKER"
+
+MODES = ("kill", "hang", "slow_heartbeat", "corrupt_checkpoint")
+
+
+def corrupt_latest_checkpoint(directory) -> Optional[Path]:
+    """Overwrite the newest ``ckpt-*.npz`` with garbage (not a zip, and
+    shorter than the original — a torn write) and leave the latest-pointer
+    aimed at it, simulating a crash mid-save that the pointer's atomic
+    rename alone cannot guard against. Returns the corrupted path, or None
+    when the directory holds no checkpoints."""
+    directory = Path(directory)
+    steps = []
+    for p in directory.glob("ckpt-*.npz"):
+        m = re.fullmatch(r"ckpt-(\d+)\.npz", p.name)
+        if m:
+            steps.append((int(m.group(1)), p))
+    if not steps:
+        return None
+    _, path = max(steps)
+    path.write_bytes(b"\x00not-a-zip\x00" * 3)
+    return path
+
+
+class FaultInjector(Callback):
+    """Inject one fault at ``at_step`` on process ``rank`` (see module doc).
+
+    ``at_step`` is compared against the model's global step counter at
+    batch end, with ``>=`` so multi-step execution (which advances the
+    counter K at a time) still triggers at the first boundary past the
+    target. ``rank=None`` faults every process.
+    """
+
+    def __init__(self, mode: str, *, at_step: int = 5,
+                 rank: Optional[int] = 0, once_marker=None,
+                 exit_code: int = 17, hang_seconds: float = 10_000.0,
+                 directory=None):
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        if mode == "corrupt_checkpoint" and directory is None:
+            raise ValueError(
+                "corrupt_checkpoint mode needs directory= (the checkpoint "
+                "dir whose newest file gets clobbered)"
+            )
+        self.mode = mode
+        self.at_step = int(at_step)
+        self.rank = rank
+        self.once_marker = Path(once_marker) if once_marker else None
+        self.exit_code = int(exit_code)
+        self.hang_seconds = float(hang_seconds)
+        self.directory = directory
+        self.fired = False
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultInjector"]:
+        """Build from ``DTPU_FAULT`` ("mode" or "mode:key=val,key=val";
+        keys: at_step, rank [int or 'all'], exit_code, hang_seconds,
+        directory) and ``DTPU_FAULT_MARKER`` (once-only arming). Returns
+        None when the variable is unset — scripts can unconditionally
+        append ``*filter(None, [FaultInjector.from_env()])``."""
+        spec = os.environ.get(ENV_VAR)
+        if not spec:
+            return None
+        mode, _, rest = spec.partition(":")
+        kw = {}
+        for part in filter(None, rest.split(",")):
+            key, _, val = part.partition("=")
+            key = key.strip()
+            if key in ("at_step", "exit_code"):
+                kw[key] = int(val)
+            elif key == "rank":
+                kw[key] = None if val == "all" else int(val)
+            elif key == "hang_seconds":
+                kw[key] = float(val)
+            elif key == "directory":
+                kw[key] = val
+            else:
+                raise ValueError(f"unknown {ENV_VAR} key {key!r} in {spec!r}")
+        marker = os.environ.get(MARKER_ENV_VAR)
+        if marker:
+            kw["once_marker"] = marker
+        return cls(mode.strip(), **kw)
+
+    def _armed(self) -> bool:
+        if self.fired:
+            return False
+        if self.once_marker is not None and self.once_marker.exists():
+            return False
+        if self.rank is not None:
+            import jax
+
+            if jax.process_index() != self.rank:
+                return False
+        return True
+
+    def on_batch_end(self, model, step, logs):
+        if step < self.at_step or not self._armed():
+            return
+        self.fired = True
+        if self.once_marker is not None:
+            self.once_marker.parent.mkdir(parents=True, exist_ok=True)
+            self.once_marker.touch()
+        events_lib.emit("fault_injected", mode=self.mode, step=int(step))
+        if self.mode == "kill":
+            os._exit(self.exit_code)
+        elif self.mode == "hang":
+            # Frozen, not dead: exit-code monitoring sees nothing; only the
+            # launcher's heartbeat liveness probe can.
+            signal.raise_signal(signal.SIGSTOP)
+        elif self.mode == "slow_heartbeat":
+            # Alive and schedulable but making no progress — the fit loop
+            # (and with it launch.heartbeat()) stalls inside this sleep.
+            time.sleep(self.hang_seconds)
+        elif self.mode == "corrupt_checkpoint":
+            corrupt_latest_checkpoint(self.directory)
+            os._exit(self.exit_code)

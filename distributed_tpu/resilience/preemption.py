@@ -1,0 +1,153 @@
+"""Preemption-aware training: catch SIGTERM, checkpoint, exit resumable.
+
+TPU fleets deliver maintenance/preemption as SIGTERM with a short grace
+window. The reference has no answer (its only guidance is "restart if any
+fails", /root/reference/README.md:400 — losing all progress). Here a
+:class:`PreemptionHandler` callback turns the signal into: finish the
+in-flight step, force a final checkpoint, write a resume marker, and exit
+with :data:`PREEMPTED_EXIT_CODE` — which the supervisor recognizes as a
+clean preemption (restarted without spending the failure budget, see
+``resilience.policy``). The relaunched run's ``ModelCheckpoint(dir,
+restore=True)`` then resumes from that exact step.
+
+The signal handler itself only sets a flag (the only async-signal-safe
+thing to do from Python); all real work — the collective checkpoint save,
+the marker write, the exit — happens at the next batch boundary on the
+normal Python stack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+from ..checkpoint import Checkpointer
+from ..checkpoint.core import _atomic_write
+from ..training.callbacks import Callback
+from ..utils import events as events_lib
+from ..utils import logging as dlog
+
+# EX_TEMPFAIL: "try again later" — distinct from any crash code, so the
+# supervisor can tell a clean preemption from a real failure.
+PREEMPTED_EXIT_CODE = 75
+
+RESUME_MARKER = "resume-marker.json"
+
+
+def marker_path(directory) -> Path:
+    return Path(directory) / RESUME_MARKER
+
+
+def write_resume_marker(directory, step: int, reason: str = "preempted") -> Path:
+    """Atomically record "this run stopped resumably at ``step``"."""
+    path = marker_path(directory)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(
+        {"step": int(step), "reason": reason, "ts": time.time()}
+    )
+    _atomic_write(path, lambda tmp: Path(tmp).write_text(payload))
+    return path
+
+
+def read_resume_marker(directory) -> Optional[dict]:
+    """The marker dict, or None when absent/corrupt (a torn marker must
+    never block a restart — the checkpoint latest-pointer is the real
+    resume source; the marker is intent metadata)."""
+    try:
+        rec = json.loads(marker_path(directory).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) and "step" in rec else None
+
+
+def clear_resume_marker(directory) -> None:
+    try:
+        marker_path(directory).unlink()
+    except OSError:
+        pass
+
+
+class PreemptionHandler(Callback):
+    """Callback: graceful-stop on SIGTERM (and any extra ``signals``).
+
+    ``directory``: where the final checkpoint and resume marker go (shared
+    with the run's ``ModelCheckpoint`` so the relaunch restores it).
+    ``exit_code``: process exit code after the final checkpoint —
+    :data:`PREEMPTED_EXIT_CODE` by default so a supervisor restarts for
+    free. ``exit_code=None`` stops in-process instead (``fit`` returns
+    early mid-epoch) — the mode tests and notebook runs want.
+
+    Multi-process gangs: resource managers deliver the preemption signal to
+    every worker of an evicted slice, so each process takes the same
+    save-at-next-boundary path and the collective save stays aligned. A
+    signal delivered to only one process of a gang is not a preemption this
+    handler can make collective-safe (documented limitation).
+    """
+
+    def __init__(self, directory, *, signals=(signal.SIGTERM,),
+                 exit_code: Optional[int] = PREEMPTED_EXIT_CODE,
+                 keep: int = 3, checkpointer: Optional[Checkpointer] = None):
+        self.directory = Path(directory)
+        self.ckpt = checkpointer or Checkpointer(directory, keep=keep)
+        self.signals = tuple(signals)
+        self.exit_code = exit_code
+        self._flag = False
+        self._prev = {}
+        self.triggered = False  # post-hoc: did a preemption stop this run?
+
+    # -- signal plumbing ----------------------------------------------------
+    def _on_signal(self, signum, frame):
+        # Async-signal context: set the flag and nothing else.
+        self._flag = True
+
+    def _install(self):
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+
+    def _uninstall(self):
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # non-main thread / closed interp
+                pass
+        self._prev = {}
+
+    # -- callback hooks -----------------------------------------------------
+    def on_train_begin(self, model):
+        self._flag = False
+        self.triggered = False
+        self._install()
+
+    def on_batch_end(self, model, step, logs):
+        if not self._flag:
+            return
+        self._flag = False
+        self.triggered = True
+        import jax
+
+        self.ckpt.save(model, step=step)
+        if jax.process_index() == 0:
+            write_resume_marker(self.directory, step)
+            dlog.warning(
+                f"PreemptionHandler: caught stop signal; checkpointed step "
+                f"{step} and "
+                + (f"exiting with code {self.exit_code}" if self.exit_code
+                   is not None else "stopping training in-process")
+            )
+            events_lib.emit("preempted", step=int(step),
+                            exit_code=self.exit_code)
+        if self.exit_code is not None:
+            self._uninstall()
+            # sys.exit, not os._exit: SystemExit unwinds the stack so log
+            # handles flush and the launcher's result file (if any) stays
+            # consistent; fit() is abandoned by design.
+            sys.exit(self.exit_code)
+        model.stop_training = True  # fit() breaks at this batch boundary
+
+    def on_train_end(self, model, history):
+        self._uninstall()

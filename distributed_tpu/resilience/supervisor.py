@@ -1,0 +1,256 @@
+"""Supervisor: a supervised, restartable training runtime.
+
+The outermost layer of the resilience subsystem — it manages the process
+lifecycle AROUND the trainer instead of code inside it. A ``Supervisor``
+owns a gang launcher (``launch.LocalLauncher`` by default, ``SSHLauncher``
+for pods), runs the training command under heartbeat liveness tracking,
+and on failure relaunches the whole gang under a
+:class:`~distributed_tpu.resilience.RestartPolicy` (bounded exponential
+backoff, max-restart budget, preemptions exempt). Every lifecycle fact is
+appended to the structured event log (``utils.events``), which it shares
+with its workers via ``DTPU_EVENT_LOG``.
+
+Recovery-without-rework stays the training script's side of the contract
+(same as ``launch.run_with_restart``): run with ``ModelCheckpoint(dir,
+restore=True)`` and a fixed seed, and a relaunch of the identical command
+restores the latest *valid* checkpoint (corrupt files are skipped, see
+``checkpoint.core``) and fast-forwards the batch stream — the supervised
+run converges bit-identically to an uninterrupted one, modulo the replayed
+partial epoch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..launch.core import LocalLauncher, WorkerResult
+from ..utils import events as events_lib
+from ..utils import logging as dlog
+from .policy import RestartPolicy
+from .preemption import (
+    PREEMPTED_EXIT_CODE,
+    clear_resume_marker,
+    read_resume_marker,
+)
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    """Outcome of a supervised run: final-attempt worker rows plus the
+    restart accounting a caller needs to reason about what happened."""
+
+    ok: bool
+    attempts: int
+    restarts_used: int
+    preemptions: int
+    results: List[WorkerResult]
+    event_log: Optional[str] = None
+
+    @property
+    def failed(self) -> List[WorkerResult]:
+        return [r for r in self.results if not r.ok]
+
+
+def _classify_preemption(failed: Sequence[WorkerResult]) -> bool:
+    """True when the attempt ended by preemption: at least one worker took
+    the PreemptionHandler exit, and every other failure is either the same
+    or the launcher's gang-kill of its peers (which is a consequence of the
+    preemption, not an independent fault)."""
+    if not failed:
+        return False
+    preempted = [r for r in failed if r.exit_code == PREEMPTED_EXIT_CODE]
+    if not preempted:
+        return False
+    rest = [r for r in failed if r.exit_code != PREEMPTED_EXIT_CODE]
+    return all("peer failure" in (r.error or "") for r in rest)
+
+
+class Supervisor:
+    """Launch-and-monitor loop for one training command.
+
+    ``argv``: the worker command (same on every attempt — the resume
+    contract is "relaunch the identical command"). ``num_workers`` applies
+    to local launchers; an ``SSHLauncher`` derives the gang from its host
+    list. ``checkpoint_dir`` (optional) lets the supervisor report resume
+    state in its events and clear the resume marker once the run finally
+    completes. ``liveness_timeout`` arms the launcher's heartbeat probe so
+    hangs are restartable too, not just crashes.
+
+    ``sleep`` is injectable for tests (backoff schedules assert without
+    waiting them out).
+    """
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        num_workers: int = 1,
+        *,
+        launcher=None,
+        policy: Optional[RestartPolicy] = None,
+        checkpoint_dir=None,
+        event_log: Optional[events_lib.EventLog] = None,
+        env_extra: Optional[Dict[str, str]] = None,
+        liveness_timeout: Optional[float] = None,
+        sleep=time.sleep,
+    ):
+        self.argv = list(argv)
+        self.num_workers = int(num_workers)
+        self.launcher = launcher if launcher is not None else LocalLauncher()
+        self.policy = policy or RestartPolicy()
+        self.checkpoint_dir = checkpoint_dir
+        self.event_log = event_log
+        self.env_extra = dict(env_extra or {})
+        self.liveness_timeout = liveness_timeout
+        self._sleep = sleep
+
+    # ------------------------------------------------------------------ event
+    def _emit(self, kind: str, **fields):
+        if self.event_log is not None:
+            try:
+                self.event_log.emit(kind, **fields)
+            except OSError:
+                pass
+
+    # ----------------------------------------------------------------- launch
+    def _attempt_env(self, attempt: int) -> Dict[str, str]:
+        env = dict(self.env_extra)
+        env["DTPU_ATTEMPT"] = str(attempt)
+        if self.event_log is not None:
+            env[events_lib.ENV_VAR] = str(self.event_log.path)
+        return env
+
+    def _launch(self, attempt: int, timeout: float, grace: float,
+                **launch_kw) -> List[WorkerResult]:
+        env = self._attempt_env(attempt)
+        kw = dict(timeout=timeout, grace=grace, **launch_kw)
+        if self.liveness_timeout is not None:
+            kw.setdefault("liveness_timeout", self.liveness_timeout)
+        try:
+            if hasattr(self.launcher, "env_extra"):
+                # LocalLauncher-style: env rides the launcher instance.
+                saved = self.launcher.env_extra
+                self.launcher.env_extra = {**saved, **env}
+                try:
+                    return self.launcher.run(self.argv, self.num_workers, **kw)
+                finally:
+                    self.launcher.env_extra = saved
+            # SSHLauncher-style: env is a run kwarg, gang size comes from
+            # the launcher's host list.
+            return self.launcher.run(self.argv, env_extra=env, **kw)
+        except RuntimeError as e:
+            # Keep the errors-as-data contract (same as run_with_restart):
+            # a preflight failure on relaunch becomes one failed row per
+            # expected worker, so result shape is stable across attempts.
+            n = len(getattr(self.launcher, "hosts", None) or []) or self.num_workers
+            return [
+                WorkerResult(index=i, ok=False, error=str(e))
+                for i in range(n)
+            ]
+
+    # -------------------------------------------------------------------- run
+    def run(self, *, timeout: float = 600.0, grace: float = 10.0,
+            **launch_kw) -> SupervisedResult:
+        """Supervise until success, budget exhaustion, or preemption-cap.
+
+        Returns the final attempt's per-worker rows (errors as data, never
+        an exception) wrapped with restart accounting."""
+        attempt = 0
+        restarts_used = 0
+        preemptions = 0
+        while True:
+            attempt += 1
+            self._emit("attempt_start", attempt=attempt,
+                       restarts_used=restarts_used, preemptions=preemptions)
+            t0 = time.monotonic()
+            results = self._launch(attempt, timeout, grace, **launch_kw)
+            failed = [r for r in results if not r.ok]
+            self._emit(
+                "attempt_end", attempt=attempt, ok=not failed,
+                duration=round(time.monotonic() - t0, 3),
+                failed_ranks=[r.index for r in failed],
+                exit_codes=[r.exit_code for r in failed],
+            )
+            if not failed:
+                if self.checkpoint_dir is not None:
+                    clear_resume_marker(self.checkpoint_dir)
+                self._emit("run_complete", attempts=attempt,
+                           restarts_used=restarts_used,
+                           preemptions=preemptions)
+                return self._result(True, attempt, restarts_used,
+                                    preemptions, results)
+            preempted = _classify_preemption(failed)
+            if preempted and self.policy.preemption_exempt:
+                if not self.policy.allows_preemption_restart(preemptions):
+                    self._emit("preemption_cap_exhausted",
+                               preemptions=preemptions)
+                    dlog.warning(
+                        f"Supervisor: preemption cap "
+                        f"({self.policy.max_preemptions}) exhausted"
+                    )
+                    return self._result(False, attempt, restarts_used,
+                                        preemptions, results)
+                preemptions += 1
+                delay, reason = 0.0, "preempted"
+            else:
+                if not self.policy.allows_restart(restarts_used):
+                    self._emit("budget_exhausted",
+                               restarts_used=restarts_used,
+                               max_restarts=self.policy.max_restarts)
+                    dlog.warning(
+                        f"Supervisor: restart budget exhausted "
+                        f"({self.policy.max_restarts} restarts); giving up"
+                    )
+                    return self._result(False, attempt, restarts_used,
+                                        preemptions, results)
+                restarts_used += 1
+                delay = self.policy.delay(restarts_used)
+                reason = "preempted" if preempted else "failure"
+            resume = self._resume_state()
+            self._emit("restart", attempt=attempt + 1, reason=reason,
+                       delay=delay, restarts_used=restarts_used,
+                       preemptions=preemptions, **resume)
+            dlog.warning(
+                f"Supervisor: {reason} on worker(s) "
+                f"{[r.index for r in failed]}; relaunching in {delay:.1f}s "
+                f"(restarts {restarts_used}/{self.policy.max_restarts}, "
+                f"preemptions {preemptions})"
+                + (f", resume from step {resume['resume_step']}"
+                   if resume.get("resume_step") is not None else "")
+            )
+            if delay > 0:
+                self._sleep(delay)
+
+    def _resume_state(self) -> Dict[str, Optional[int]]:
+        """What the relaunch is expected to resume from: the latest VALID
+        checkpoint step (corrupt latest files excluded, same scan restore
+        uses) plus any resume-marker step a preemption recorded."""
+        if self.checkpoint_dir is None:
+            return {}
+        from ..checkpoint import Checkpointer
+
+        step = Checkpointer(self.checkpoint_dir).latest_valid_step()
+        marker = read_resume_marker(self.checkpoint_dir)
+        return {
+            "resume_step": step,
+            "marker_step": marker["step"] if marker else None,
+        }
+
+    def _result(self, ok, attempts, restarts_used, preemptions, results):
+        return SupervisedResult(
+            ok=ok,
+            attempts=attempts,
+            restarts_used=restarts_used,
+            preemptions=preemptions,
+            results=results,
+            event_log=(str(self.event_log.path)
+                       if self.event_log is not None else None),
+        )
+
+
+def supervise(argv: Sequence[str], num_workers: int = 1, **kw) -> SupervisedResult:
+    """One-call form: ``supervise([sys.executable, "train.py"], 4,
+    checkpoint_dir=..., liveness_timeout=60).ok``."""
+    run_kw = {k: kw.pop(k) for k in ("timeout", "grace") if k in kw}
+    return Supervisor(argv, num_workers, **kw).run(**run_kw)

@@ -15,6 +15,7 @@ gang. EarlyStopping decides from epoch logs that are already all-reduced
 from __future__ import annotations
 
 import math
+import os
 from pathlib import Path
 from typing import Optional
 
@@ -22,6 +23,7 @@ import jax
 import numpy as np
 
 from ..checkpoint import Checkpointer, ShardedCheckpointer
+from ..utils import events as devents
 from ..utils import logging as dlog
 
 
@@ -193,11 +195,21 @@ class EarlyStopping(Callback):
 
 
 class CSVLogger(Callback):
-    """Append epoch logs to a CSV file (chief-only)."""
+    """Append epoch logs to a CSV file (chief-only). Every row is flushed
+    AND fsynced before training continues: a run killed mid-epoch (crash,
+    preemption, supervisor liveness kill) leaves all completed epochs
+    durable on disk — the crash-visible log the resilience post-mortem
+    reads next to the event log."""
 
     def __init__(self, path):
         self.path = Path(path)
         self._keys = None
+
+    def _append_durable(self, text: str):
+        with open(self.path, "a") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
 
     def on_epoch_end(self, model, epoch, logs):
         if jax.process_index() != 0:
@@ -206,12 +218,11 @@ class CSVLogger(Callback):
             self._keys = sorted(logs)
             self.path.parent.mkdir(parents=True, exist_ok=True)
             if not self.path.exists():
-                self.path.write_text("epoch," + ",".join(self._keys) + "\n")
+                self._append_durable("epoch," + ",".join(self._keys) + "\n")
         row = [str(epoch)] + [
             repr(float(logs.get(k, float("nan")))) for k in self._keys
         ]
-        with open(self.path, "a") as f:
-            f.write(",".join(row) + "\n")
+        self._append_durable(",".join(row) + "\n")
 
 
 class LearningRateScheduler(Callback):
@@ -464,7 +475,16 @@ class SyncCheck(Callback):
             return
         from ..utils.sync_check import assert_replicas_identical
 
-        assert_replicas_identical(model.params, "params")
-        assert_replicas_identical(model.state, "state")
-        if self.include_opt_state:
-            assert_replicas_identical(model.opt_state, "opt_state")
+        try:
+            assert_replicas_identical(model.params, "params")
+            assert_replicas_identical(model.state, "state")
+            if self.include_opt_state:
+                assert_replicas_identical(model.opt_state, "opt_state")
+        except AssertionError as e:
+            # Divergence still fails the run (the invariant is hard), but
+            # it ALSO lands in the resilience event log first: after the
+            # supervisor's gang-kill + restart, the post-mortem names the
+            # drifted parameter without trawling worker stderr.
+            devents.emit("sync_check_failed", epoch=int(epoch),
+                         step=int(model.step), error=str(e))
+            raise

@@ -811,6 +811,12 @@ class Model:
                         cb.on_batch_end(self, self.step, {"loss": loss})
                     if bar is not None:
                         bar.update(step_i + 1)
+                    if self.stop_training:
+                        # Graceful mid-epoch stop (PreemptionHandler's
+                        # in-process mode): the partial epoch's metrics are
+                        # reported over the steps that actually ran, and the
+                        # checkpoint/step cursor resumes exactly here.
+                        break
             else:
                 # steps_per_execution=K: one fused dispatch per K steps.
                 # An epoch tail (or a mid-epoch resume) shorter than K runs
@@ -843,8 +849,15 @@ class Model:
                         cb.on_batch_end(self, self.step, {"loss": loss_sum / k})
                     if bar is not None:
                         bar.update(done)
+                    if self.stop_training:
+                        break  # graceful mid-epoch stop, K-step granularity
             if bar is not None:
                 bar.close()
+            # Steps that actually ran this epoch: a graceful mid-epoch stop
+            # (stop_training at a batch boundary) ends the epoch early, and
+            # every per-step average below must reflect reality, not plan.
+            steps_run = len(losses) if multi_k == 1 else done
+            epoch_steps = steps_run
             # One host sync per epoch: the loss and every metric accumulator
             # fetch in a SINGLE device_get. Under multi-step execution the
             # list entries are already on-device K-step sums.
@@ -852,7 +865,7 @@ class Model:
             if multi_k == 1:
                 logs = {"loss": float(np.mean(losses))}
             else:
-                logs = {"loss": float(np.sum(losses) / epoch_steps)}
+                logs = {"loss": float(np.sum(losses) / max(epoch_steps, 1))}
             # The device_get above is where async dispatch catches up with
             # real compute — beat again so the epoch-end window (sync +
             # validation + callbacks below) starts freshly armed.

@@ -1,0 +1,86 @@
+"""Structured resilience event log (JSONL, crash-visible).
+
+The supervisor, the checkpoint restore path, and training callbacks all
+report lifecycle facts (restarts, preemptions, corrupt-checkpoint skips,
+sync-check failures) through one append-only JSONL file, so a post-mortem
+of a supervised run is a single `read_events(path)` away — including runs
+that died mid-write (every record is flushed AND fsynced before the caller
+continues, and a torn final line is skipped on read, never a parse error).
+
+Transport: the supervisor exports ``DTPU_EVENT_LOG`` to its workers, so
+worker-side emitters (callbacks, restore fallback) land in the same file
+the supervisor writes its attempt records to. Without the env var (and
+without an explicit ``EventLog``), ``emit`` is a no-op — unsupervised runs
+pay nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+ENV_VAR = "DTPU_EVENT_LOG"
+
+
+class EventLog:
+    """Append-only JSONL event sink with durability per record."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = {"ts": time.time(), "event": kind, "pid": os.getpid(), **fields}
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        return rec
+
+    def read(self) -> List[dict]:
+        return read_events(self.path)
+
+
+def read_events(path) -> List[dict]:
+    """All well-formed records, in order. A torn trailing line (the writer
+    died mid-append before fsync) is dropped silently — a crash must never
+    make the post-mortem log unreadable."""
+    out: List[dict] = []
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return out
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def default_log() -> Optional[EventLog]:
+    """The ambient event log: ``$DTPU_EVENT_LOG`` (set by the supervisor for
+    every worker it launches), else None. Re-read per call — the supervisor
+    sets the variable after worker import time."""
+    path = os.environ.get(ENV_VAR)
+    return EventLog(path) if path else None
+
+
+def emit(kind: str, **fields) -> Optional[dict]:
+    """Emit to the ambient log; no-op (returns None) when unsupervised.
+    Emission must never take a run down: I/O errors are swallowed — the
+    event log is observability, not control flow."""
+    log = default_log()
+    if log is None:
+        return None
+    try:
+        return log.emit(kind, **fields)
+    except OSError:
+        return None
