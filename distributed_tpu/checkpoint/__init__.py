@@ -6,12 +6,14 @@ from .core import (
     import_hdf5,
     load_npz,
     save_npz,
+    wait_all_async,
 )
 from .sharded import ShardedCheckpointer
 
 __all__ = [
     "Checkpointer",
     "ShardedCheckpointer",
+    "wait_all_async",
     "save_npz",
     "load_npz",
     "export_hdf5",

@@ -23,6 +23,8 @@ import json
 import os
 import re
 import tempfile
+import threading
+import weakref
 import zipfile
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
@@ -31,6 +33,22 @@ import jax
 import numpy as np
 
 SEP = "/"
+
+# Checkpointers with a live (or ever-started) background writer, so a
+# process-wide barrier (wait_all_async) can flush every pending write —
+# the PreemptionHandler's pre-exit flush without needing a reference to
+# each callback's private Checkpointer.
+_ASYNC_CHECKPOINTERS: "weakref.WeakSet[Checkpointer]" = weakref.WeakSet()
+
+
+def wait_all_async() -> None:
+    """Barrier over EVERY Checkpointer that has started a background save:
+    blocks until all in-flight writes have fully landed (npz + gc + latest
+    pointer). Writer errors propagate. The preemption path calls this
+    before its final synchronous save so an older in-flight write can
+    never land after — and shadow — the preemption checkpoint."""
+    for ck in list(_ASYNC_CHECKPOINTERS):
+        ck.wait()
 
 # What a torn/garbage checkpoint file raises out of np.load/json meta decode:
 # truncated zips (BadZipFile/EOFError/OSError), non-zip garbage and bad
@@ -110,10 +128,37 @@ def _atomic_write(path: Path, write_fn):
     os.close(tmp_fd)
     try:
         write_fn(tmp_name)
+        # fsync BEFORE the rename: os.replace is atomic in the namespace
+        # but not durable — a power cut after the rename could otherwise
+        # surface a present-but-empty file under the real name, which the
+        # corrupt-skip scan would then have to spend a step on.
+        fd = os.open(tmp_name, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp_name, path)
     finally:
         if os.path.exists(tmp_name):
             os.unlink(tmp_name)
+
+
+def _device_snapshot(tree):
+    """Donation-safe copy of a pytree for a background writer: jax leaves
+    get an on-device copy (enqueued NOW, on the caller's thread, so it is
+    ordered before any later dispatch that donates the original buffers),
+    numpy leaves a host copy. The background thread then fetches from the
+    snapshot at leisure while training keeps donating the originals."""
+    import jax.numpy as jnp
+
+    def cp(a):
+        if isinstance(a, jax.Array):
+            return jnp.copy(a)
+        if isinstance(a, np.ndarray):
+            return np.array(a, copy=True)
+        return a
+
+    return jax.tree_util.tree_map(cp, tree)
 
 
 # ---------------------------------------------------------------------- npz --
@@ -212,13 +257,32 @@ class Checkpointer:
     When the newest file is corrupt anyway (torn by the filesystem, or a
     fault-injection test), auto-restore skips it and falls back to the
     previous step instead of failing the relaunch.
+
+    ``async_save=True`` moves the expensive half of every save — the
+    device->host fetch, npz serialization, fsync, gc, and the atomic
+    ``latest`` pointer update — onto a background writer thread, so the
+    train loop resumes after only a cheap on-device snapshot
+    (donation-safe copies, see ``_device_snapshot``). Ordering contract:
+    a new ``save`` first ``wait()``s out any in-flight write (a newer
+    step can never race an older one for the pointer), and ``wait()`` is
+    the explicit barrier — ``ModelCheckpoint`` calls it at train end,
+    the preemption path flushes every live writer
+    (``wait_all_async``) before exiting 75. Writer errors surface at the
+    next ``save``/``wait``, never silently. Multi-process gangs fall
+    back to synchronous saves: gathering non-addressable leaves is a
+    collective, which must not run on a background thread concurrently
+    with training collectives.
     """
 
     LATEST_NAME = "latest"
 
-    def __init__(self, directory, keep: int = 3):
+    def __init__(self, directory, keep: int = 3, async_save: bool = False):
         self.directory = Path(directory)
         self.keep = int(keep)
+        self.async_save = bool(async_save)
+        self._writer: Optional[threading.Thread] = None
+        self._writer_error: Optional[BaseException] = None
+        self._writer_lock = threading.Lock()
 
     def _path(self, step: int) -> Path:
         return self.directory / f"ckpt-{step}.npz"
@@ -307,6 +371,20 @@ class Checkpointer:
             if steps else f"No checkpoints in {self.directory}"
         )
 
+    def wait(self) -> None:
+        """Barrier: block until the in-flight background save (if any) has
+        fully landed — npz on disk (fsynced), old steps gc'd, ``latest``
+        pointer updated. Re-raises the writer's exception if it failed.
+        No-op for synchronous checkpointers, so callers can always call
+        it unconditionally at fit end / before exit."""
+        with self._writer_lock:
+            writer, self._writer = self._writer, None
+        if writer is not None:
+            writer.join()
+        err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise err
+
     def save(self, model, step: Optional[int] = None) -> Path:
         step = model.step if step is None else step
         tree = {
@@ -319,10 +397,39 @@ class Checkpointer:
             "seed": int(model._seed),
             "input_shape": list(model.input_shape or ()),
         }
+        # Serialize the step family: an older in-flight write must land
+        # (and any error surface) before a newer save may start.
+        self.wait()
+        if self.async_save and jax.process_count() == 1:
+            return self._save_async(tree, meta, int(step))
         path = save_npz(self._path(step), tree, meta)
         if _is_chief():
             self._gc()
             self._write_latest_pointer(step)
+        return path
+
+    def _save_async(self, tree, meta: dict, step: int) -> Path:
+        """Background half of an async save: snapshot on the caller's
+        thread (cheap, device-side, ordered before future donations),
+        then fetch + serialize + fsync + gc + pointer on a writer."""
+        snap = _device_snapshot(tree)
+        path = self._path(step)
+
+        def write():
+            try:
+                save_npz(path, snap, meta)
+                self._gc()
+                self._write_latest_pointer(step)
+            except BaseException as e:  # surfaced at the next save/wait
+                self._writer_error = e
+
+        writer = threading.Thread(
+            target=write, name="dtpu-ckpt-writer", daemon=True
+        )
+        with self._writer_lock:
+            self._writer = writer
+        _ASYNC_CHECKPOINTERS.add(self)
+        writer.start()
         return path
 
     def _gc(self):

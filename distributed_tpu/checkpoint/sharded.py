@@ -133,6 +133,13 @@ class ShardedCheckpointer:
         # sharded leaves — the whole point of the format).
         self.last_max_block_bytes = 0
 
+    def wait(self) -> None:
+        """No-op barrier: sharded saves are synchronous (every process
+        writes its own shard blocks inline; the cross-host commit barrier
+        makes a background writer collective-unsafe). Present so generic
+        callers (ModelCheckpoint train-end, the preemption flush) can call
+        ``wait()`` on either checkpointer flavor."""
+
     # ------------------------------------------------------------- layout --
     def _step_dir(self, step: int) -> Path:
         return self.directory / f"ckpt-{step}"

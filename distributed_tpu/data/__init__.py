@@ -10,9 +10,11 @@ from .datasets import (
 )
 from .filesource import FileSource, write_shards
 from .pipeline import Pipeline, native_available
+from .prefetch import DevicePrefetcher
 
 __all__ = [
     "Pipeline",
+    "DevicePrefetcher",
     "FileSource",
     "write_shards",
     "native_available",

@@ -157,6 +157,15 @@ class Pipeline:
     ):
         from .filesource import FileSource
 
+        # Teardown-critical fields FIRST: __del__ runs on instances whose
+        # __init__ raised partway (bad batch_size, a failed native handle),
+        # and close() must find a consistent shape to tear down.
+        self._lib = None
+        self._handle = None
+        self._closed = False
+        self._py_step = 0
+        self.steps_emitted = 0  # lets fit() fast-forward on resume
+
         # x is either an in-memory uint8 array or a file-backed shard set
         # (FileSource, or a directory path); the file case streams through
         # memory-mapped spans and never loads the dataset into RAM.
@@ -216,10 +225,6 @@ class Pipeline:
         if use_native is True and lib is None:
             raise RuntimeError("Native pipeline requested but unavailable")
         self._lib = lib
-        self._handle = None
-        self._py_step = 0
-        self._closed = False
-        self.steps_emitted = 0  # lets fit() fast-forward on resume
         if lib is not None:
             self._handle = self._create_handle(0)
 
@@ -268,10 +273,14 @@ class Pipeline:
         if step < 0:
             raise ValueError(f"seek target must be >= 0, got {step}")
         if self._handle is not None:
-            self._lib.dtpu_pipeline_destroy(self._handle)
+            # Detach before destroy/recreate: if _create_handle fails here,
+            # close()/__del__ must not double-destroy the old handle.
+            handle, self._handle = self._handle, None
+            self._lib.dtpu_pipeline_destroy(handle)
             self._handle = self._create_handle(step)
         else:
             self._py_step = step
+            self._perm_cache = None
         self.steps_emitted = step
 
     @property
@@ -354,10 +363,24 @@ class Pipeline:
         return xs, ys
 
     def close(self):
+        """Idempotent shutdown, safe in every degraded state: a partially
+        constructed instance (``__init__`` raised before the native handle
+        existed), a repeated close, and interpreter shutdown — where module
+        globals (the ctypes lib, its function pointers) may already be torn
+        down while native prefetch threads are still live. Every lookup is
+        defensive and the destroy itself is allowed to fail silently; the
+        alternative is an exception out of ``__del__`` at exit."""
         self._closed = True
-        if self._handle is not None:
-            self._lib.dtpu_pipeline_destroy(self._handle)
-            self._handle = None
+        handle = getattr(self, "_handle", None)
+        self._handle = None
+        if handle:
+            destroy = getattr(getattr(self, "_lib", None),
+                              "dtpu_pipeline_destroy", None)
+            if destroy is not None:
+                try:
+                    destroy(handle)
+                except Exception:
+                    pass  # shutdown-time ctypes teardown; nothing to save
 
     def __del__(self):
         try:

@@ -1,0 +1,162 @@
+"""Device prefetch: overlap host batch prep + H2D transfer with compute.
+
+The train loop's dispatch of a DONATED step blocks until the previous
+dispatch's execution completes (the donated params buffer must be free
+before the next program can take it), so everything the host does between
+dispatches — assembling the next (super-)batch and starting its
+host->device transfer — sits on the step's critical path. A
+:class:`DevicePrefetcher` moves that work onto a bounded background
+producer: while dispatch N executes, the producer prepares and *places*
+the batch for dispatch N+1 (``strategy.put_batch(..., async_=True)`` — a
+non-blocking ``jax.device_put``, never a ``block_until_ready``), so the
+main thread's only per-dispatch cost is a queue pop.
+
+Determinism: batches are produced by ONE thread, in order, from the same
+source cursor the synchronous loop would advance — the staged stream is
+bit-identical to the unprefetched one, and per-step RNG never moves (it is
+keyed on the global step, not on wall time). ``sizes`` fixes the exact
+dispatch sizes up front, so a normally-completed epoch consumes exactly
+``sum(sizes)`` source steps — no over-read at epoch end. An early stop
+(``stop_training`` mid-epoch) leaves up to ``depth + 1`` staged dispatches
+unconsumed; :attr:`unconsumed_steps` reports how many source STEPS those
+held so a seekable source (``data.Pipeline``) can be rewound to the step
+the model actually reached.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Sequence
+
+__all__ = ["DevicePrefetcher"]
+
+_POLL_S = 0.05  # producer/consumer wake-up period for stop/error checks
+
+
+class DevicePrefetcher:
+    """Bounded background producer of device-staged batches.
+
+    Args:
+      stage: ``stage(k) -> staged_batch`` — prepares ``k`` source steps'
+        worth of host data and starts its device placement. Runs on the
+        producer thread (or inline when ``depth == 0``); must therefore be
+        non-blocking on the device (no fetches, no collectives).
+      sizes: the exact sequence of per-dispatch sizes this prefetcher will
+        serve, in order (``[1, 1, ...]`` for the plain loop, ``[K, ...,
+        tail]`` under ``steps_per_execution=K``).
+      depth: how many staged dispatches may be ready ahead of the consumer
+        (the double-buffering default is 2). ``0`` disables the thread
+        entirely — ``get()`` stages inline, byte-for-byte the synchronous
+        path.
+    """
+
+    def __init__(self, stage: Callable, sizes: Sequence[int], depth: int = 2):
+        self._stage = stage
+        self._sizes = [int(k) for k in sizes]
+        self._depth = max(0, int(depth))
+        self._served = 0  # dispatches handed to the consumer
+        self._produced_steps = 0  # source steps pulled by the producer
+        self._served_steps = 0
+        self._error = None
+        self._stop = threading.Event()
+        self._q = None
+        self._thread = None
+        if self._depth > 0 and len(self._sizes) > 1:
+            self._q = queue.Queue(maxsize=self._depth)
+            self._thread = threading.Thread(
+                target=self._run, name="dtpu-prefetch", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _run(self):
+        try:
+            for k in self._sizes:
+                if self._stop.is_set():
+                    return
+                item = self._stage(k)
+                # Counted at stage time, queued or not: these source steps
+                # are gone from the stream either way, and unconsumed_steps
+                # must account for an item stranded by a mid-put stop.
+                self._produced_steps += k
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=_POLL_S)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+        except BaseException as e:  # surfaced to the consumer in get()
+            self._error = e
+
+    # ------------------------------------------------------------- consumer
+    def get(self):
+        """The next staged dispatch as ``(k, staged_batch)``. Blocks until
+        the producer has it ready; re-raises any producer-side exception
+        (source exhaustion, placement errors) with its original type."""
+        if self._served >= len(self._sizes):
+            raise IndexError("prefetcher exhausted: all dispatches served")
+        k = self._sizes[self._served]
+        if self._thread is None:  # depth 0 / single dispatch: synchronous
+            if self._error is None:
+                try:
+                    item = self._stage(k)
+                    self._produced_steps += k
+                except BaseException as e:
+                    self._error = e
+            if self._error is not None:
+                raise self._error
+        else:
+            while True:
+                try:
+                    item = self._q.get(timeout=_POLL_S)
+                    break
+                except queue.Empty:
+                    if self._error is not None:
+                        raise self._error
+                    if not self._thread.is_alive() and self._q.empty():
+                        raise RuntimeError(
+                            "prefetch producer exited without staging the "
+                            "requested dispatch"
+                        )
+        self._served += 1
+        self._served_steps += k
+        return k, item
+
+    # ------------------------------------------------------------- shutdown
+    @property
+    def unconsumed_steps(self) -> int:
+        """Source steps staged (or in staging) but never served — nonzero
+        only after an early ``close()``. The caller rewinds a seekable
+        source by this much to realign it with the consumed stream."""
+        return self._produced_steps - self._served_steps
+
+    def close(self, join_timeout: float = 10.0):
+        """Idempotent shutdown: stop the producer, drain staged items, and
+        join the thread. Never raises — close is cleanup; errors the
+        consumer cares about surfaced in get()."""
+        self._stop.set()
+        if self._thread is not None:
+            while True:  # unblock a producer stuck in q.put
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._thread.join(timeout=join_timeout)
+        # A staged-but-undrained item could still have landed between the
+        # drain and the join; empty the queue once more so its device
+        # buffers are released promptly.
+        if self._q is not None:
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

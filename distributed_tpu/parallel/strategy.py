@@ -89,7 +89,7 @@ class Strategy:
         return self.put_params(tx.init(params))
 
     def put_batch(self, batch, per_host: bool = False,
-                  stacked: bool = False):
+                  stacked: bool = False, async_: bool = False):
         """Place a numpy batch onto devices. ``per_host=True`` means each
         process passes only ITS row-shard of the global batch (from e.g. a
         sharded ``data.Pipeline``); the shards assemble into one global
@@ -100,7 +100,17 @@ class Strategy:
         (``Model.compile(steps_per_execution=K)``) — the leading K axis is
         replicated and the SECOND axis is the batch axis: every sharding
         rule shifts one dimension right, so one transfer stages K steps of
-        data exactly as K separate ``put_batch`` calls would have."""
+        data exactly as K separate ``put_batch`` calls would have.
+
+        ``async_=True``: the caller is a background prefetch stage
+        (``data.DevicePrefetcher``) staging dispatch N+1 while dispatch N
+        runs — the call MUST only *start* the host->device transfer
+        (non-blocking ``jax.device_put``) and must never synchronize
+        (``block_until_ready``, ``device_get``) or run a collective. Every
+        strategy's placement already satisfies this; the flag is the
+        contract that keeps any future implementation honest, and the
+        hook under which one could route placement through a dedicated
+        transfer stream."""
         if per_host:
             raise ValueError(
                 f"{type(self).__name__} cannot assemble per-host input "
@@ -121,8 +131,9 @@ class SingleDevice(Strategy):
         self.device = device or jax.devices()[0]
 
     def put_batch(self, batch, per_host: bool = False,
-                  stacked: bool = False):
-        # stacked super-batches need no special placement on one device.
+                  stacked: bool = False, async_: bool = False):
+        # stacked super-batches need no special placement on one device;
+        # device_put is already non-blocking, satisfying async_.
         if per_host:
             raise ValueError(
                 "SingleDevice cannot assemble per-host input shards; a "
@@ -180,7 +191,7 @@ class DataParallel(Strategy):
         return jax.device_put(params, rep)
 
     def put_batch(self, batch, per_host: bool = False,
-                  stacked: bool = False):
+                  stacked: bool = False, async_: bool = False):
         """Place a batch. Host-global by default (same array on every
         process, like the reference's full-dataset-everywhere feeding,
         /root/reference/README.md:369-373, with each process device-putting
@@ -595,7 +606,7 @@ class DataSeqParallel(DataParallel):
         return NamedSharding(self.mesh, PartitionSpec(self.axis, self.seq_axis))
 
     def put_batch(self, batch, per_host: bool = False,
-                  stacked: bool = False):
+                  stacked: bool = False, async_: bool = False):
         return _put_batch_rows_seq(
             self.mesh, self.axis, self.seq_axis, batch, per_host, stacked
         )
@@ -740,7 +751,7 @@ class CompositeParallel(_HintedParallel):
         return NamedSharding(self.mesh, PartitionSpec(self._row_axes))
 
     def put_batch(self, batch, per_host: bool = False,
-                  stacked: bool = False):
+                  stacked: bool = False, async_: bool = False):
         rows = self._row_axes if len(self._row_axes) > 1 else self._row_axes[0]
         return _put_batch_rows_seq(
             self.mesh, rows, self.seq_axis, batch, per_host, stacked

@@ -130,7 +130,18 @@ class PreemptionHandler(Callback):
         self.triggered = True
         import jax
 
+        from ..checkpoint.core import wait_all_async
+
+        # Flush ordering (the preemption contract with async checkpointing):
+        # (1) every in-flight background write — e.g. the run's
+        # ModelCheckpoint(async_save=True) writer — lands first, so an older
+        # step can never finish after (and point `latest` away from) the
+        # preemption save; (2) the final save runs; (3) its own writer is
+        # flushed, so exit 75 never abandons a half-written final
+        # checkpoint. See docs/RESILIENCE.md "Preemption handling".
+        wait_all_async()
         self.ckpt.save(model, step=step)
+        self.ckpt.wait()
         if jax.process_index() == 0:
             write_resume_marker(self.directory, step)
             dlog.warning(

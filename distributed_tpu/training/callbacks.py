@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import math
 import os
+import time
 from pathlib import Path
 from typing import Optional
 
@@ -56,15 +57,34 @@ class ModelCheckpoint(Callback):
     optimizer steps. ``restore=True`` resumes from the latest checkpoint in
     the directory at train begin (no-op when the directory is empty), making
     crash-restart a relaunch of the identical command.
+
+    ``async_save=True`` hands each save to ``Checkpointer``'s background
+    writer: the train loop pays only a device-side snapshot, and the
+    fetch/serialize/fsync/pointer-update overlap the following steps. The
+    writer is flushed (``Checkpointer.wait()``) at train end — and by the
+    preemption path before exit 75 — so fit never returns with a write in
+    flight. Time blocked on saves/flushes is attributed to the active
+    fit's ``checkpoint_wait`` stall bucket (``model.last_fit_telemetry``).
     """
 
     def __init__(self, directory, *, save_freq="epoch", keep: int = 3,
-                 restore: bool = False, sharded: bool = False):
+                 restore: bool = False, sharded: bool = False,
+                 async_save: bool = False):
         # sharded=True switches to the per-process ShardedCheckpointer
         # (requires a directory shared across hosts; hosts only touch their
         # own shards — the right format for FSDP/TP-scale models).
-        cls = ShardedCheckpointer if sharded else Checkpointer
-        self.ckpt = cls(directory, keep=keep)
+        if sharded:
+            if async_save:
+                raise ValueError(
+                    "async_save is not supported with sharded=True: the "
+                    "sharded commit is a cross-host barrier, which cannot "
+                    "run on a background thread concurrently with training "
+                    "collectives"
+                )
+            self.ckpt = ShardedCheckpointer(directory, keep=keep)
+        else:
+            self.ckpt = Checkpointer(directory, keep=keep,
+                                     async_save=async_save)
         if save_freq != "epoch" and not (
             isinstance(save_freq, int) and save_freq > 0
         ):
@@ -72,6 +92,18 @@ class ModelCheckpoint(Callback):
         self.save_freq = save_freq
         self.restore = restore
         self._last_bucket = 0  # save_freq bucket already saved (int freq)
+
+    def _timed(self, model, fn):
+        """Run a (possibly blocking) checkpoint operation, attributing the
+        blocked wall time to the active fit's checkpoint_wait bucket."""
+        t0 = time.perf_counter()
+        try:
+            return fn()
+        finally:
+            timer = getattr(model, "_stall_timer", None)
+            if timer is not None:
+                timer.attribute("checkpoint_wait",
+                                time.perf_counter() - t0)
 
     def on_train_begin(self, model):
         if self.restore:
@@ -110,11 +142,17 @@ class ModelCheckpoint(Callback):
         bucket = step // self.save_freq
         if bucket > self._last_bucket:
             self._last_bucket = bucket
-            self.ckpt.save(model)
+            self._timed(model, lambda: self.ckpt.save(model))
 
     def on_epoch_end(self, model, epoch, logs):
         if self.save_freq == "epoch":
-            self.ckpt.save(model)
+            self._timed(model, lambda: self.ckpt.save(model))
+
+    def on_train_end(self, model, history):
+        # Flush the background writer before fit returns: callers read,
+        # copy, or restore from the directory immediately after fit, and a
+        # run that exits right after must leave a complete newest step.
+        self._timed(model, self.ckpt.wait)
 
 
 def _metric_mode(monitor: str) -> str:

@@ -24,6 +24,7 @@ TPU-first internals (what changed under the hood):
 from __future__ import annotations
 
 import functools
+import os
 import time
 from typing import Dict, Iterable, Optional, Sequence, Tuple
 
@@ -128,6 +129,8 @@ class Model:
         self.steps_per_execution = None  # compile(steps_per_execution=K)
         self.stop_training = False  # callbacks (EarlyStopping) set this
         self._resumed_step = None  # set by a restoring ModelCheckpoint
+        self._stall_timer = None  # live StepTimer of the fit in progress
+        self.last_fit_telemetry = None  # stall_report() of the last fit
         self._param_hints = {}  # TP role tree, populated by build()
         self._seed = 0
         self._train_step = None
@@ -646,7 +649,22 @@ class Model:
         initial_epoch: int = 0,
         seed: Optional[int] = None,
         callbacks: Sequence = (),
+        prefetch: Optional[int] = None,
     ) -> History:
+        """``prefetch``: device-prefetch depth — how many dispatches' input
+        may be staged (host-prepped AND placed on device) ahead of the one
+        executing, by a bounded background producer
+        (``data.DevicePrefetcher``). Donated dispatches block the host for
+        the duration of the previous step, so without prefetch every
+        batch's prep + transfer sits on the step's critical path; with it
+        the main thread's per-dispatch input cost is a queue pop. Default
+        2 (double buffering); 0 stages synchronously inline (the
+        pre-overlap loop). The staged stream is produced in order from the
+        same cursor, so numerics are bit-identical at any depth, and a
+        mid-epoch stop rewinds a seekable source (``data.Pipeline``) to
+        the step actually trained. Per-fit stall accounting (input_wait /
+        dispatch / checkpoint_wait seconds and the input-stall fraction)
+        lands in ``model.last_fit_telemetry``."""
         if not self.compiled:
             raise RuntimeError("Call compile() before fit()")
         if y is None:
@@ -708,6 +726,17 @@ class Model:
             )
         multi_k = self.steps_per_execution or 1
         step_fn = self._get_train_step() if multi_k == 1 else None
+        if prefetch is None:
+            prefetch = int(os.environ.get("DTPU_PREFETCH_DEPTH", "2"))
+        prefetch = max(0, int(prefetch))
+        from ..data.prefetch import DevicePrefetcher
+        from ..utils.profiler import StepTimer
+
+        # Stall accounting for this fit: input_wait / dispatch /
+        # checkpoint_wait (callbacks attribute the latter through
+        # model._stall_timer). Summarized into last_fit_telemetry at exit.
+        timer = StepTimer(warmup=0)
+        self._stall_timer = timer
         history = History()
         is_chief = jax.process_index() == 0
         self.stop_training = False
@@ -786,82 +815,120 @@ class Model:
                 bar = ProgressLine(
                     epoch_steps, prefix=f"Epoch {epoch + 1}/{epochs}: "
                 )
+            # Per-dispatch sizes are fixed up front ([1, 1, ...] plain;
+            # [K, ..., tail] fused — an epoch tail or mid-epoch resume
+            # shorter than K runs as a smaller final dispatch, so no batch
+            # is skipped or replayed and resume needs no K-rounding). The
+            # exact schedule lets the prefetch producer stage ahead without
+            # ever over-consuming the source at a normal epoch end.
             if multi_k == 1:
-                for step_i in range(epoch_steps):
+                sizes = [1] * epoch_steps
+
+                def stage(k):
                     xb, yb = next_batch()
-                    batch = self.strategy.put_batch(
-                        {"x": xb, "y": yb}, per_host=per_host
+                    return self.strategy.put_batch(
+                        {"x": xb, "y": yb}, per_host=per_host, async_=True
                     )
-                    rng = self._step_rng()
-                    self.params, self.state, self.opt_state, loss, mvals = \
-                        step_fn(
+
+            else:
+                sizes, left = [], epoch_steps
+                while left > 0:
+                    sizes.append(min(multi_k, left))
+                    left -= sizes[-1]
+                multi_fn = self._get_multi_step_train_step()
+                base_rng = jax.random.PRNGKey(self._seed + 1)
+
+                def stage(k):
+                    xs, ys = next_k_batches(k)
+                    return self.strategy.put_batch(
+                        {"x": xs, "y": ys}, per_host=per_host, stacked=True,
+                        async_=True,
+                    )
+
+            # Input overlap: a bounded producer preps + places dispatch
+            # N+1 while dispatch N executes (donated dispatches block the
+            # host until the previous step completes, so staged input is
+            # the difference between a stalled and a saturated device).
+            # depth 0 stages inline — byte-identical, just synchronous.
+            staged = DevicePrefetcher(stage, sizes, depth=prefetch)
+            done = 0
+            try:
+                for k in sizes:
+                    tw = time.perf_counter()
+                    _, batch = staged.get()
+                    timer.attribute("input_wait", time.perf_counter() - tw)
+                    td = time.perf_counter()
+                    if multi_k == 1:
+                        rng = self._step_rng()
+                        (self.params, self.state, self.opt_state, loss,
+                         mvals) = step_fn(
                             self.params, self.state, self.opt_state,
                             batch["x"], batch["y"], rng,
                         )
-                    self.step += 1
+                        loss_log = loss
+                    else:
+                        (self.params, self.state, self.opt_state, loss,
+                         mvals) = multi_fn(
+                            self.params, self.state, self.opt_state,
+                            batch["x"], batch["y"], base_rng,
+                            np.int32(self.step),
+                        )
+                        # Callbacks see the dispatch's per-step mean, as a
+                        # device scalar (reading it still costs a sync).
+                        loss_log = loss / k
+                    timer.attribute("dispatch", time.perf_counter() - td)
+                    self.step += k
+                    done += k
                     # Liveness beat for gang launchers (throttled no-op
-                    # outside a gang): a worker blocked at a collective stops
-                    # beating and the launcher's liveness_timeout
+                    # outside a gang): a worker blocked at a collective
+                    # stops beating and the launcher's liveness_timeout
                     # gang-restarts it.
                     _gang_heartbeat()
-                    losses.append(loss)
+                    losses.append(loss)  # per-step loss, or K-step sum
                     for name, _ in self.metric_fns:
                         msums[name].append(mvals[name])
+                    # Callbacks fire once per dispatch (K-step granularity
+                    # under steps_per_execution).
                     for cb in callbacks:
-                        cb.on_batch_end(self, self.step, {"loss": loss})
+                        cb.on_batch_end(self, self.step, {"loss": loss_log})
                     if bar is not None:
-                        bar.update(step_i + 1)
+                        bar.update(done)
                     if self.stop_training:
                         # Graceful mid-epoch stop (PreemptionHandler's
                         # in-process mode): the partial epoch's metrics are
                         # reported over the steps that actually ran, and the
                         # checkpoint/step cursor resumes exactly here.
                         break
-            else:
-                # steps_per_execution=K: one fused dispatch per K steps.
-                # An epoch tail (or a mid-epoch resume) shorter than K runs
-                # as a smaller final dispatch, so no batch is ever skipped
-                # or replayed and resume needs no K-rounding.
-                multi_fn = self._get_multi_step_train_step()
-                base_rng = jax.random.PRNGKey(self._seed + 1)
-                done = 0
-                while done < epoch_steps:
-                    k = min(multi_k, epoch_steps - done)
-                    xs, ys = next_k_batches(k)
-                    batch = self.strategy.put_batch(
-                        {"x": xs, "y": ys}, per_host=per_host, stacked=True
-                    )
-                    (self.params, self.state, self.opt_state, loss_sum,
-                     mvals) = multi_fn(
-                        self.params, self.state, self.opt_state,
-                        batch["x"], batch["y"], base_rng, np.int32(self.step),
-                    )
-                    self.step += k
-                    done += k
-                    _gang_heartbeat()
-                    losses.append(loss_sum)  # on-device K-step sum
-                    for name, _ in self.metric_fns:
-                        msums[name].append(mvals[name])
-                    # Callbacks fire once per dispatch (K-step granularity);
-                    # the loss they see is the dispatch's per-step mean, as
-                    # a device scalar (reading it still costs a host sync).
-                    for cb in callbacks:
-                        cb.on_batch_end(self, self.step, {"loss": loss_sum / k})
-                    if bar is not None:
-                        bar.update(done)
-                    if self.stop_training:
-                        break  # graceful mid-epoch stop, K-step granularity
+            finally:
+                staged.close()
+                if staged.unconsumed_steps and y is None:
+                    # The producer staged past a mid-epoch stop (or an
+                    # error); rewind a seekable source so its cursor
+                    # matches the steps actually trained — keeping
+                    # steps_emitted == consumed for resume/diagnostics.
+                    if hasattr(source, "seek") and (
+                        getattr(source, "steps_emitted", None) is not None
+                    ):
+                        try:
+                            source.seek(
+                                source.steps_emitted - staged.unconsumed_steps
+                            )
+                        except ValueError:
+                            pass  # source already closed; nothing to realign
             if bar is not None:
                 bar.close()
             # Steps that actually ran this epoch: a graceful mid-epoch stop
             # (stop_training at a batch boundary) ends the epoch early, and
             # every per-step average below must reflect reality, not plan.
-            steps_run = len(losses) if multi_k == 1 else done
-            epoch_steps = steps_run
+            epoch_steps = done
             # One host sync per epoch: the loss and every metric accumulator
             # fetch in a SINGLE device_get. Under multi-step execution the
-            # list entries are already on-device K-step sums.
+            # list entries are already on-device K-step sums. This is where
+            # async dispatch catches up with real compute — attributed to
+            # dispatch time, like the donation waits it back-loads.
+            td = time.perf_counter()
             losses, fetched = jax.device_get((losses, msums))
+            timer.attribute("dispatch", time.perf_counter() - td)
             if multi_k == 1:
                 logs = {"loss": float(np.mean(losses))}
             else:
@@ -912,7 +979,12 @@ class Model:
             if self.stop_training:
                 break
         for cb in callbacks:
+            # on_train_end BEFORE the telemetry summary: ModelCheckpoint's
+            # train-end wait() (flushing a background writer) attributes
+            # its blocked time to checkpoint_wait and must be counted.
             cb.on_train_end(self, history)
+        self.last_fit_telemetry = timer.stall_report()
+        self._stall_timer = None
         return history
 
     # --------------------------------------------------------------- evaluate
@@ -1101,6 +1173,11 @@ class Model:
             pending.append(step_fn(self.params, self.state, xb))
             if len(pending) >= window:
                 fetched.append(np.asarray(jax.device_get(pending.pop(0))))
+        # Tail drain: one batched readiness wait over EVERYTHING still in
+        # the window, then the fetches — not a per-array device_get chain,
+        # where each array would serialize a full transport round-trip
+        # behind the previous one's.
+        pending = jax.block_until_ready(pending)
         fetched.extend(np.asarray(o) for o in jax.device_get(pending))
         return np.concatenate(
             [o[:v] for o, v in zip(fetched, valids)], axis=0

@@ -44,6 +44,15 @@ class StepTimer:
 
     Used standalone around a custom loop, or via `report()` for one-line
     telemetry. Warmup steps (compile) are excluded from the rate.
+
+    Stall accounting: ``attribute(category, seconds)`` accrues wall time
+    into named buckets — the train loop uses the convention
+    ``{input_wait, dispatch, checkpoint_wait}`` (time blocked waiting for
+    the next staged batch / blocked on the device behind a donated
+    dispatch / blocked on checkpoint saves-and-flushes), and
+    ``stall_report()`` turns the buckets into seconds + fractions of the
+    timer's lifetime, including the ``input_stall_fraction`` that
+    ``bench.py overlap`` compares across prefetch depths.
     """
 
     def __init__(self, warmup: int = 1):
@@ -51,6 +60,8 @@ class StepTimer:
         self.steps = 0
         self._t0 = None
         self._measured_from = 0  # step count when the clock started
+        self.stalls = {}  # category -> accumulated seconds
+        self._wall0 = time.perf_counter()
 
     def tick(self, steps: int = 1):
         """Count ``steps`` completed optimizer steps. Pass ``steps=K`` when
@@ -64,6 +75,32 @@ class StepTimer:
         if self._t0 is None and self.steps >= self.warmup:
             self._t0 = time.perf_counter()
             self._measured_from = self.steps
+
+    def attribute(self, category: str, seconds: float):
+        """Accrue ``seconds`` of wall time to a stall ``category``. The
+        train loop's categories: ``input_wait`` (blocked on the staged
+        batch), ``dispatch`` (blocked on the device — donated dispatches
+        wait out the previous step), ``checkpoint_wait`` (blocked on
+        checkpoint saves/flushes). Free-form categories are allowed for
+        custom loops."""
+        self.stalls[category] = self.stalls.get(category, 0.0) + float(seconds)
+
+    def stall_report(self) -> dict:
+        """Attributed seconds per category, the timer's total lifetime
+        (``total_seconds``, wall clock since construction), and
+        ``input_stall_fraction`` = input_wait / total — the number
+        prefetching exists to drive to ~0. Unattributed time (callbacks,
+        Python bookkeeping, epoch sync) is the difference between the
+        categories' sum and the total."""
+        elapsed = max(time.perf_counter() - self._wall0, 1e-9)
+        out = {}
+        for cat in ("input_wait", "dispatch", "checkpoint_wait"):
+            out[cat] = round(self.stalls.get(cat, 0.0), 6)
+        for cat, secs in self.stalls.items():
+            out[cat] = round(secs, 6)
+        out["total_seconds"] = round(elapsed, 6)
+        out["input_stall_fraction"] = round(out["input_wait"] / elapsed, 6)
+        return out
 
     @property
     def steps_per_sec(self) -> float:

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Canonical tier-1 test runner — THE command from ROADMAP.md "Tier-1
+# verify", wrapped once so builders, CI, and humans all invoke the same
+# thing instead of each re-typing (and drifting from) the incantation.
+#
+#   ./scripts/tier1.sh            # run from the repo root
+#
+# Behavior, matching the ROADMAP contract exactly:
+#   - XLA:CPU only (JAX_PLATFORMS=cpu; conftest.py simulates 8 devices)
+#   - quiet, non-slow tests, collection errors don't abort the run
+#   - hard timeout (870 s + 10 s kill grace): a hung suite still reports
+#   - DOTS_PASSED=<n> printed at the end: the per-test tally survives a
+#     timeout kill (pytest's own summary would not), and the incremental
+#     ledger .pytest_progress.txt names every completed test either way
+#   - exit status is pytest's (or 124 on timeout), NOT tee's
+
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+exit "$rc"

@@ -19,6 +19,8 @@ import jax  # noqa: E402
 # TPU tunnel); the config update is authoritative.
 jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
 
 
@@ -27,6 +29,22 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(autouse=True)
+def assert_no_leaked_dtpu_threads():
+    """Thread-leak check for the overlap subsystems: the device-prefetch
+    producer ("dtpu-prefetch") and the async checkpoint writer
+    ("dtpu-ckpt-writer") are named background threads that every fit()/
+    Checkpointer.wait() must fully retire — a leak here is a real bug (a
+    producer blocked on a queue, a writer never flushed), so EVERY test's
+    teardown asserts none survive."""
+    yield
+    leaked = [
+        t.name for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("dtpu-")
+    ]
+    assert not leaked, f"leaked dtpu background threads: {leaked}"
 
 
 # ---------------------------------------------------------------------------

@@ -90,6 +90,7 @@ def test_bench_output_contract(monkeypatch, capsys):
                       "vs_baseline": 2.0},
     )
     monkeypatch.setattr(bench, "bench_multi_step", lambda **kw: {"metric": "k"})
+    monkeypatch.setattr(bench, "bench_overlap", lambda **kw: {"metric": "o"})
     monkeypatch.setattr(bench, "bench_convergence", lambda **kw: {"metric": "c"})
     monkeypatch.setattr(bench, "bench_cifar", lambda **kw: {"metric": "f"})
     monkeypatch.setattr(bench, "bench_resnet50", lambda **kw: {"metric": "r"})
@@ -100,7 +101,8 @@ def test_bench_output_contract(monkeypatch, capsys):
     assert len(lines) == 1
     rec = json.loads(lines[0])
     assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
-    assert [e["metric"] for e in rec["extra"]] == ["k", "c", "f", "r", "t"]
+    assert [e["metric"] for e in rec["extra"]] == ["k", "o", "c", "f", "r",
+                                                   "t"]
     assert "device" in rec
 
 
@@ -113,6 +115,18 @@ def test_bench_multistep_smoke():
     assert row2["steps_per_execution"] == 2 and row2["value"] > 0
     assert "k2" in out["speedup_vs_k1"]
     assert len(out["window_steps_per_sec"]) == 3
+
+
+def test_bench_overlap_smoke():
+    """The input-overlap mode: tiny window, near-zero injected latency —
+    the real depth-0-vs-2 comparison runs via `python bench.py overlap`."""
+    out = bench.bench_overlap(batch=8, measure_steps=3, repeats=1,
+                              n_rows=128, fetch_latency_ms=1.0)
+    assert out["prefetch_depth"] == 0 and out["value"] > 0
+    assert 0.0 <= out["input_stall_fraction"] <= 1.0
+    (row2,) = out["rows"]
+    assert row2["prefetch_depth"] == 2 and row2["value"] > 0
+    assert "d2" in out["speedup_vs_depth0"]
 
 
 def test_bench_cifar_smoke():

@@ -130,6 +130,44 @@ class TestNativeSpecifics:
         p.close()
         p.close()
 
+    def test_close_safe_after_failed_handle_creation(self, monkeypatch):
+        """A Pipeline whose native handle creation failed partway must
+        tear down cleanly: close()/__del__ on the half-constructed
+        instance never raises, and never double-destroys — the
+        interpreter-shutdown hazard with native prefetch threads live."""
+        created = Pipeline.__new__(Pipeline)  # no __init__ at all
+        created.close()  # only defensive lookups; must not raise
+        created.close()
+
+        def boom(self, start_step):
+            raise RuntimeError("dtpu_pipeline_create failed")
+
+        monkeypatch.setattr(Pipeline, "_create_handle", boom)
+        x, y = _dataset()
+        with pytest.raises(RuntimeError, match="create failed"):
+            Pipeline(x, y, 8, use_native=True)
+        # __del__ of the failed instance runs at gc with no error (it
+        # would print to stderr otherwise); nothing further to assert —
+        # the absence of an exception IS the contract.
+
+    def test_seek_failure_leaves_no_dangling_handle(self, monkeypatch):
+        """seek() destroys the old native handle before building the new
+        one; if the rebuild fails, close() must not destroy the old
+        handle a second time."""
+        x, y = _dataset()
+        p = Pipeline(x, y, 8, use_native=True)
+        orig = Pipeline._create_handle
+
+        def boom(self, start_step):
+            raise RuntimeError("rebuild failed")
+
+        monkeypatch.setattr(Pipeline, "_create_handle", boom)
+        with pytest.raises(RuntimeError, match="rebuild failed"):
+            p.seek(3)
+        assert p._handle is None  # detached before the failed rebuild
+        p.close()  # no double-destroy
+        monkeypatch.setattr(Pipeline, "_create_handle", orig)
+
 
 class TestFitFromPipeline:
     def test_fit_trains_from_iterator(self):
