@@ -29,6 +29,10 @@ modes, ``python bench.py longctx`` measures the long-context rows
 (docs/PERF.md table) — opt-in, large compiles — and ``python bench.py
 resilience`` measures supervisor heartbeat overhead and restart-to-first-
 step latency (docs/RESILIENCE.md) — opt-in, spawns worker subprocesses.
+``python bench.py zero`` compares per-device model-state memory and steps/s
+for replicated DP vs ZeRO-1 vs FSDP, plus a simulated-HBM-cap row where
+only FSDP fits (BENCH_zero.json) — opt-in, needs a multi-device mesh
+(run under XLA_FLAGS=--xla_force_host_platform_device_count=8 on CPU).
 """
 
 import json
@@ -655,6 +659,127 @@ def bench_transformer_lm(batch=32, seq_len=1024, vocab=32768, num_layers=12,
     return out
 
 
+# -------------------------------------------------------------------- zero --
+def bench_zero(vocab=512, num_layers=2, d_model=256, num_heads=4, seq_len=64,
+               batch=32, warmup=2, measure=10, windows=3,
+               big_vocab=2048, big_layers=4, big_d_model=768,
+               hbm_cap_mb=256):
+    """ZeRO memory/throughput comparison (``python bench.py zero``,
+    artifact BENCH_zero.json).
+
+    Part 1 — fixed global batch: a small Adam transformer LM trained under
+    ``DataParallel`` (replicated), ``ZeroDataParallel`` (ZeRO-1) and
+    ``FSDP`` (ZeRO-3 over 'data'). Reports steps/s on the compiled train
+    step (median-of-3 windows, same protocol as every mode) and the
+    MEASURED per-device model-state bytes (params + opt state, summed from
+    shard buffer sizes — exact on any backend; the allocator peak is also
+    reported where the backend exposes one, which XLA:CPU does not).
+    With Adam the expected ratio vs replicated is (1+2/N)/3 for ZeRO-1 and
+    ~1/N for FSDP on an N-way mesh.
+
+    Part 2 — simulated HBM cap: a ~4x bigger LM whose replicated model
+    state exceeds ``hbm_cap_mb`` per device. Replication would OOM a chip
+    with that HBM; FSDP's per-device share fits, and the bench proves the
+    config TRAINS by running real optimizer steps under FSDP. Replicated
+    bytes are computed from the same tree's global leaf sizes (building
+    the replicated model just to watch it not fit would be the OOM).
+    """
+    from distributed_tpu.utils.profiler import (
+        device_memory_stats, tree_bytes_per_device)
+
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, vocab, (batch, seq_len + 1), dtype=np.int64)
+    xb, yb = tok[:, :-1].astype(np.int32), tok[:, 1:].astype(np.int32)
+
+    n_dev = len(jax.devices())
+    strategies = [("replicated_dp", dtpu.DataParallel)]
+    if n_dev > 1:
+        strategies += [("zero1", dtpu.ZeroDataParallel), ("fsdp", dtpu.FSDP)]
+    rows = []
+    for name, strategy_cls in strategies:
+        strategy = strategy_cls() if n_dev > 1 else dtpu.SingleDevice()
+        with strategy.scope():
+            model = dtpu.Model(dtpu.models.transformer_lm(
+                vocab, num_layers=num_layers, d_model=d_model,
+                num_heads=num_heads, max_len=seq_len))
+            model.compile(optimizer=dtpu.optim.Adam(1e-3),
+                          loss="sparse_categorical_crossentropy")
+        model.build((seq_len,))
+        dev_batch = model.strategy.put_batch({"x": xb, "y": yb})
+        # Before timing: _time_steps donates the model's buffers into the
+        # step, deleting the originals.
+        state_bytes = tree_bytes_per_device(
+            model.params, model.state, model.opt_state)
+        sps, win = _time_steps(model, dev_batch, warmup, measure,
+                               windows=windows)
+        rows.append({
+            "metric": f"lm_zero_{name}_steps_per_sec_gb{batch}",
+            "value": round(sps, 3),
+            "unit": "steps/s",
+            "strategy": name,
+            "model_state_bytes_per_device": state_bytes["max_bytes_per_device"],
+            "allocator": device_memory_stats(),
+            "window_steps_per_sec": win,
+        })
+        del model, dev_batch
+
+    out = dict(rows[0])
+    by_name = {r["strategy"]: r for r in rows}
+    if "zero1" in by_name:
+        rep = by_name["replicated_dp"]
+        out["hbm_ratio_vs_replicated"] = {
+            n: round(rep["model_state_bytes_per_device"]
+                     / by_name[n]["model_state_bytes_per_device"], 2)
+            for n in by_name if n != "replicated_dp"
+        }
+        out["steps_per_sec_vs_replicated"] = {
+            n: round(by_name[n]["value"] / rep["value"], 2)
+            for n in by_name if n != "replicated_dp"
+        }
+
+    # ---- part 2: the config replication cannot hold under the HBM cap ----
+    if n_dev > 1:
+        cap = int(hbm_cap_mb) * 1024 * 1024
+        big_tok = rng.integers(0, big_vocab, (n_dev, seq_len + 1),
+                               dtype=np.int64)
+        strategy = dtpu.FSDP()
+        with strategy.scope():
+            big = dtpu.Model(dtpu.models.transformer_lm(
+                big_vocab, num_layers=big_layers, d_model=big_d_model,
+                num_heads=num_heads, max_len=seq_len))
+            big.compile(optimizer=dtpu.optim.Adam(1e-3),
+                        loss="sparse_categorical_crossentropy")
+        big.build((seq_len,))
+        fsdp_bytes = tree_bytes_per_device(
+            big.params, big.state, big.opt_state)["max_bytes_per_device"]
+        # Replicated per-device state = the SAME tree at global leaf sizes.
+        replicated_bytes = sum(
+            int(l.nbytes) for tree in (big.params, big.state, big.opt_state)
+            for l in jax.tree_util.tree_leaves(tree)
+            if isinstance(l, jax.Array)
+        )
+        hist = big.fit(big_tok[:, :-1].astype(np.int32),
+                       big_tok[:, 1:].astype(np.int32),
+                       batch_size=n_dev, epochs=1, steps_per_epoch=2,
+                       verbose=0, seed=0)
+        out["hbm_cap_row"] = {
+            "hbm_cap_bytes": cap,
+            "replicated_state_bytes_per_device": replicated_bytes,
+            "replicated_fits": replicated_bytes <= cap,
+            "fsdp_state_bytes_per_device": fsdp_bytes,
+            "fsdp_fits": fsdp_bytes <= cap,
+            "fsdp_trained_steps": 2,
+            "fsdp_final_loss": round(float(hist.history["loss"][-1]), 4),
+            "params": int(sum(
+                int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(big.params))),
+        }
+        del big
+    if len(rows) > 1:
+        out["rows"] = rows[1:]
+    return out
+
+
 # -------------------------------------------------------------- resilience --
 def bench_resilience(throttled_calls=1_000_000, beats=50_000,
                      train_steps=8, kill_step=3, save_freq=2):
@@ -841,7 +966,7 @@ def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
 def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
                 "resnet50", "lm")):
     known = {"mnist", "multistep", "overlap", "convergence", "cifar",
-             "resnet50", "lm", "longctx", "resilience"}
+             "resnet50", "lm", "longctx", "resilience", "zero"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -863,6 +988,11 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         extra.append(bench_transformer_lm())
     if "longctx" in modes:
         extra.append(bench_longctx())
+    if "zero" in modes:
+        # Opt-in: ZeRO-1/FSDP memory + throughput vs replicated DP
+        # (BENCH_zero.json; docs/PERF.md "Memory: ZeRO & gradient
+        # accumulation").
+        extra.append(bench_zero())
     if "resilience" in modes:
         # Opt-in (like longctx): spawns supervised worker subprocesses.
         extra.append(bench_resilience())

@@ -38,10 +38,12 @@ from .parallel.strategy import (
     DataSeqParallel,
     DataExpertParallel,
     DataTensorParallel,
+    FSDP,
     FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
+    ZeroDataParallel,
     current_strategy,
 )
 from .training.history import History
@@ -59,8 +61,10 @@ __all__ = [
     "DataSeqParallel",
     "DataExpertParallel",
     "DataTensorParallel",
+    "FSDP",
     "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",
+    "ZeroDataParallel",
     "current_strategy",
     "make_mesh",
     "Checkpointer",

@@ -254,6 +254,13 @@ class Checkpointer:
     the real name. ``restore_into(model)`` reloads the latest (or a given
     step) and re-places arrays under the model's strategy, so a resumed
     run continues bit-identically on any mesh with the same replica count.
+    That re-placement is what makes checkpoints STRATEGY-PORTABLE: the
+    optimizer state grafts onto a template from the live strategy's
+    ``init_opt_state`` — a run saved under replicated ``DataParallel``
+    resumes under ``ZeroDataParallel``/``FSDP`` with the moments coming
+    back data-sharded (and vice versa), and ``inject_hyperparams``
+    wrappers round-trip their live values (a runtime-set learning rate
+    survives the resume; tests/test_zero.py pins both).
     When the newest file is corrupt anyway (torn by the filesystem, or a
     fault-injection test), auto-restore skips it and falls back to the
     previous step instead of failing the relaunch.

@@ -18,6 +18,11 @@ scale-out design:
   blocks overlapping each requested shard. Because blocks carry explicit
   start offsets, the restoring mesh may have a different shape or axis
   layout than the saving one (resharding happens block-by-block on read).
+  This covers the STRATEGY as well as the mesh: optimizer state saved
+  from a ZeRO-1/FSDP run (data-sharded moments next to replicated
+  ``inject_hyperparams`` scalars) restores into whatever the live
+  strategy's ``init_opt_state`` template dictates — ZeRO-1 -> FSDP, FSDP
+  -> replicated, any direction (tests/test_zero.py).
 
 Restore assumes the checkpoint directory is visible to every process
 (shared filesystem / object store) — the standard deployment for sharded

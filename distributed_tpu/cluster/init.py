@@ -25,6 +25,30 @@ _initialized = False
 _gathered_cache = None  # explicit-coordinator spec, cached after the gather
 
 
+def _enable_cpu_collectives():
+    """Give a multi-process CPU gang a working collectives layer.
+
+    XLA:CPU compiles cross-process computations only through a host
+    collectives implementation (gloo); without one, the FIRST cross-process
+    operation — even a replicated ``device_put`` onto a 2-process mesh —
+    fails with "Multiprocess computations aren't implemented on the CPU
+    backend". TPU/GPU backends bring their own collectives, so this flips
+    the switch only when the platform is explicitly CPU (the CI sim and
+    the launcher tests), and must run BEFORE the backend initializes —
+    which holds here because initialize() is documented as
+    before-any-device-computation. Best-effort: a jax build without the
+    gloo option keeps its old behavior."""
+    plats = (
+        jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS") or ""
+    ).lower()
+    if "cpu" not in [p.strip() for p in plats.split(",")]:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # unknown config / unsupported build
+        pass
+
+
 def _gathered_workers(coordinator: str, n: int, index: int) -> list:
     """Real rank-ordered worker list for an explicit-coordinator init: every
     process contributes its own address via a host-level allgather (must run
@@ -116,6 +140,7 @@ def initialize(
             # re-enter it; the first call's result answers this one.
             return _gathered_cache
         if n > 1 and not _initialized:
+            _enable_cpu_collectives()
             jax.distributed.initialize(
                 coordinator_address=coordinator,
                 num_processes=n,
@@ -133,6 +158,7 @@ def initialize(
         # (debugging one worker on a pod VM must not be hijacked by
         # auto-detect).
         if spec.num_processes > 1 and not _initialized:
+            _enable_cpu_collectives()
             jax.distributed.initialize(
                 coordinator_address=spec.coordinator,
                 num_processes=spec.num_processes,

@@ -5,10 +5,12 @@ from .strategy import (
     DataSeqParallel,
     DataExpertParallel,
     DataTensorParallel,
+    FSDP,
     FullyShardedDataParallel,
     MultiWorkerMirroredStrategy,
     SingleDevice,
     Strategy,
+    ZeroDataParallel,
     current_strategy,
 )
 
@@ -24,7 +26,9 @@ __all__ = [
     "DataSeqParallel",
     "DataExpertParallel",
     "DataTensorParallel",
+    "FSDP",
     "FullyShardedDataParallel",
     "MultiWorkerMirroredStrategy",
+    "ZeroDataParallel",
     "current_strategy",
 ]

@@ -52,6 +52,24 @@ def _put_global(x, sh: NamedSharding):
     return jax.device_put(x, sh)
 
 
+def _largest_divisible_spec(shape, n: int, axis: str,
+                            taken=None) -> PartitionSpec:
+    """ZeRO placement rule shared by every sharded-state strategy: shard the
+    largest dimension divisible by the axis size ``n``; replicate scalars and
+    awkward shapes (they're small). ``taken``: per-dim entries already
+    assigned to other mesh axes (kept, never double-sharded)."""
+    spec = list(taken) if taken is not None else [None] * len(shape)
+    best, best_size = None, 0
+    for d, size in enumerate(shape):
+        if spec[d] is None and size % n == 0 and size > best_size:
+            best, best_size = d, size
+    if best is not None:
+        spec[best] = axis
+    if all(s is None for s in spec):
+        return PartitionSpec()  # fully replicated, canonical spelling
+    return PartitionSpec(*spec)
+
+
 class Strategy:
     """Base strategy: knows the mesh and how to place params and batches."""
 
@@ -87,6 +105,19 @@ class Strategy:
     def init_opt_state(self, tx, params):
         """Optimizer state placed consistently with the params."""
         return self.put_params(tx.init(params))
+
+    def constrain_step(self, params, opt_state):
+        """Trace-time sharding constraints on a train step's updated
+        (params, opt_state), applied inside the jitted step after the
+        optimizer update. The default pins nothing — GSPMD's propagation
+        is already unambiguous when params and optimizer state share one
+        placement. Strategies that MIX placements (ZeRO: replicated params
+        next to sharded optimizer state) override this to pin each output
+        to its intended layout; otherwise propagation is free to leak the
+        optimizer's sharding into the updated params (or vice versa),
+        silently changing the layout — and the compiled program — from
+        step 2 on."""
+        return params, opt_state
 
     def put_batch(self, batch, per_host: bool = False,
                   stacked: bool = False, async_: bool = False):
@@ -220,6 +251,62 @@ class DataParallel(Strategy):
                 f"Global batch {global_batch} not divisible by {n} replicas"
             )
         return global_batch // n
+
+
+class ZeroDataParallel(DataParallel):
+    """ZeRO-1 data parallelism: params replicated, optimizer state sharded
+    over the 'data' axis (Rajbhandari et al., 2020, stage 1 — expressed as
+    NamedShardings the GSPMD way, Xu et al., 2021).
+
+    The forward/backward is bit-identical to ``DataParallel`` (same batch
+    sharding, same gradient all-reduce); only the optimizer update is
+    partitioned: each device keeps 1/N of every Adam/momentum statistic on
+    its largest divisible dim, computes its slice of the parameter update,
+    and XLA all-gathers the updates back onto the replicated params. Per-
+    device optimizer memory drops from O(params x stats) to O(params x
+    stats / N) — with Adam that cuts total model state from ~3x params to
+    ~(1 + 2/N)x — at the cost of one all-gather of update-sized data per
+    step, which rides the same ICI links as the gradient all-reduce.
+    Checkpoints are strategy-portable: save gathers full leaves, restore
+    re-places under the live strategy (checkpoint/core.py).
+    """
+
+    def _opt_spec(self, shape) -> PartitionSpec:
+        return _largest_divisible_spec(
+            shape, int(self.mesh.shape[self.axis]), self.axis
+        )
+
+    def _shardable(self, a) -> bool:
+        # In-trace (constrain_step) and eager (init) leaves both expose
+        # shape/ndim; python scalars and 0-d leaves stay replicated.
+        return getattr(a, "ndim", 0) >= 1
+
+    def init_opt_state(self, tx, params):
+        opt = super().init_opt_state(tx, params)  # eager init, replicated
+        rep_spec = PartitionSpec()
+
+        def place(a):
+            if not self._shardable(a):
+                return a
+            spec = self._opt_spec(a.shape)
+            if spec == rep_spec:
+                return a
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(place, opt)
+
+    def constrain_step(self, params, opt_state):
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        params = jax.tree_util.tree_map(
+            lambda p: jax.lax.with_sharding_constraint(p, rep), params
+        )
+        opt_state = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(
+                a, NamedSharding(self.mesh, self._opt_spec(a.shape))
+            ) if self._shardable(a) else a,
+            opt_state,
+        )
+        return params, opt_state
 
 
 def _check_pipe_divisible(params, hints, n: int, axis_name: str):
@@ -463,18 +550,9 @@ class FullyShardedDataParallel(_HintedParallel):
         super().__init__(mesh=mesh, axis=axis)
 
     def _spec_for(self, shape) -> PartitionSpec:
-        n = int(self.mesh.shape[self.axis])
-        # Largest dimension divisible by the axis size; replicate scalars
-        # and awkward shapes (they're small).
-        best, best_size = None, 0
-        for d, size in enumerate(shape):
-            if size % n == 0 and size > best_size:
-                best, best_size = d, size
-        if best is None:
-            return PartitionSpec()
-        spec = [None] * len(shape)
-        spec[best] = self.axis
-        return PartitionSpec(*spec)
+        return _largest_divisible_spec(
+            shape, int(self.mesh.shape[self.axis]), self.axis
+        )
 
     def params_sharding(self, params, hints=None):
         return jax.tree_util.tree_map(
@@ -486,6 +564,51 @@ class FullyShardedDataParallel(_HintedParallel):
         return jax.device_put(params, self.params_sharding(params))
     # init_opt_state inherited from _HintedParallel (eager init: stats
     # inherit their parameter's sharding, fresh scalars replicate).
+
+    def constrain_step(self, params, opt_state):
+        """Pin updated params AND optimizer state to the per-shape ZeRO
+        spec: every placement here is a pure function of the leaf's shape,
+        so the constraint is reconstructable on tracers and keeps the
+        layout fixed across steps instead of relying on propagation."""
+        def pin(a):
+            if getattr(a, "ndim", 0) < 1:
+                return a
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(self.mesh, self._spec_for(a.shape))
+            )
+
+        return (
+            jax.tree_util.tree_map(pin, params),
+            jax.tree_util.tree_map(pin, opt_state),
+        )
+
+
+class FSDP(FullyShardedDataParallel):
+    """ZeRO-3-style fully sharded data parallelism over the **'data'** axis.
+
+    Same mechanics as ``FullyShardedDataParallel`` (params + optimizer
+    state sharded on each tensor's largest divisible dim; XLA all-gathers
+    params per use and reduce-scatters gradients back to the shards), but
+    the shard axis IS the batch axis — the standard ZeRO-3/FSDP recipe
+    where one device group provides both data parallelism and parameter
+    sharding, so the whole mesh contributes to a single sharded replica.
+    Per-device model state is O(params x stats / N): with Adam, ~3x params
+    replicated drops to ~3x/N — the axis that trains models which OOM
+    under replication (``bench.py zero``'s simulated-HBM-cap row).
+
+    Compared side by side:
+
+    - ``DataParallel``:       params 1x,   opt 1x per device
+    - ``ZeroDataParallel``:   params 1x,   opt 1/N per device (ZeRO-1)
+    - ``FSDP``:               params 1/N,  opt 1/N per device (ZeRO-3)
+
+    For hybrids (fsdp x tensor parallel, fsdp as one axis of several) use
+    ``CompositeParallel`` — this class is the single-axis form.
+    """
+
+    def __init__(self, devices=None, *, mesh: Optional[Mesh] = None,
+                 axis: str = "data"):
+        super().__init__(devices, mesh=mesh, axis=axis)
 
 
 class DataPipelineParallel(_HintedParallel):

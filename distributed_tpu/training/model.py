@@ -62,6 +62,18 @@ def _split_head(module):
     return layers[:-1], layers[-1]
 
 
+def _constrain_step_outputs(params, opt_state):
+    """Apply the ambient strategy's trace-time output constraints to a train
+    step's updated (params, opt_state). ZeRO strategies pin their mixed
+    placements here (replicated params next to data-sharded optimizer
+    state) so GSPMD propagation cannot drift the layout between steps; for
+    everything else this is the identity."""
+    strat = current_strategy()
+    if strat is None:
+        return params, opt_state
+    return strat.constrain_step(params, opt_state)
+
+
 def _aux_loss_sum(state):
     """Sum of all leaves named 'aux_loss' anywhere in a state tree."""
     total = 0.0
@@ -134,7 +146,8 @@ class Model:
         self._param_hints = {}  # TP role tree, populated by build()
         self._seed = 0
         self._train_step = None
-        self._multi_train_step = None
+        self._multi_train_steps = {}  # accum_m -> fused K-step dispatch
+        self._accum_train_steps = {}  # grad_accum M -> jitted accum step
         self._eval_step = None
         self._predict_step = None
         self._generate_fns = {}  # (shapes, sampling config) -> jitted scan (LRU)
@@ -254,7 +267,9 @@ class Model:
             int(steps_per_execution) if steps_per_execution else None
         )
         self.compiled = True
-        self._train_step = self._eval_step = self._multi_train_step = None
+        self._train_step = self._eval_step = None
+        self._multi_train_steps = {}
+        self._accum_train_steps = {}
         if self.built:
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self
@@ -304,12 +319,32 @@ class Model:
         opt_state, loss, {metric: value})``. ``_get_train_step`` jits it
         directly (the K=1 path, unchanged); ``_get_multi_step_train_step``
         scans it K times inside one jit."""
-        if self.head_chunks and self.head_chunks > 1:
-            return self._chunked_train_step_body()
-        module, tx, loss_fn = self.module, self.tx, self.loss_fn
-        metric_fns = tuple(self.metric_fns)
+        grad_eval = self._grad_eval_body()
+        tx = self.tx
 
         def step(params, state, opt_state, x, y, rng):
+            loss, new_state, grads, mvals = grad_eval(
+                params, state, x, y, rng
+            )
+            updates, new_opt = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_params, new_opt = _constrain_step_outputs(new_params, new_opt)
+            return new_params, new_state, new_opt, loss, mvals
+
+        return step
+
+    def _grad_eval_body(self):
+        """The forward+backward half of a train step — ``(params, state, x,
+        y, rng) -> (loss, new_state, grads, mvals)`` — shared by the
+        one-shot step (gradient straight into the optimizer) and the
+        ``fit(grad_accum=M)`` scan (gradients accumulated across M
+        microbatches before ONE update). Plain or chunked-head."""
+        if self.head_chunks and self.head_chunks > 1:
+            return self._chunked_grad_eval_body()
+        module, loss_fn = self.module, self.loss_fn
+        metric_fns = tuple(self.metric_fns)
+
+        def grad_eval(params, state, x, y, rng):
             def loss_f(p):
                 logits, new_state = module.apply(p, state, x, train=True, rng=rng)
                 # Layers may report auxiliary objectives (e.g. MoE router
@@ -323,12 +358,10 @@ class Model:
             (loss, (new_state, logits)), grads = jax.value_and_grad(
                 loss_f, has_aux=True
             )(params)
-            updates, new_opt = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
             mvals = {name: fn(logits, y) for name, fn in metric_fns}
-            return new_params, new_state, new_opt, loss, mvals
+            return loss, new_state, grads, mvals
 
-        return step
+        return grad_eval
 
     def _chunked_head_scan(self, params, state, h, y, weights, train):
         """Shared by the chunked train and eval paths: apply the head +
@@ -413,13 +446,12 @@ class Model:
         mvals = {name: m for (name, _), m in zip(metric_fns, msums)}
         return loss_sum, jnp.sum(wf), mvals
 
-    def _chunked_train_step_body(self):
-        """Train step for compile(head_chunks=C): body applies once, the
+    def _chunked_grad_eval_body(self):
+        """Grad-eval for compile(head_chunks=C): body applies once, the
         head + loss run chunk-by-chunk (see _chunked_head_scan)."""
-        module, tx = self.module, self.tx
-        body_layers, _ = _split_head(module)
+        body_layers, _ = _split_head(self.module)
 
-        def step(params, state, opt_state, x, y, rng):
+        def grad_eval(params, state, x, y, rng):
             def loss_f(p):
                 h, new_state = _apply_layers(
                     body_layers, p, state, x, train=True, rng=rng
@@ -433,13 +465,89 @@ class Model:
             (loss, (new_state, mvals)), grads = jax.value_and_grad(
                 loss_f, has_aux=True
             )(params)
+            return loss, new_state, grads, mvals
+
+        return grad_eval
+
+    def _accum_train_step_body(self, m: int):
+        """Train body for ``fit(grad_accum=M)``: same ``(params, state,
+        opt_state, x, y, rng) -> (params, state, opt_state, loss, mvals)``
+        signature as ``_train_step_body``, but x/y carry a leading ``[M]``
+        microbatch axis. The M forward/backward passes run as a
+        ``lax.scan`` (so peak activation memory is ONE microbatch's, the
+        whole point), gradients accumulate in f32 as a carry, metrics as
+        (sum, count), and a SINGLE optimizer update applies the mean
+        gradient at the end — the update an M-times-larger batch would
+        take, with the optimizer state advancing once. Per-microbatch RNG
+        is ``fold_in(step_rng, i)``; the reported loss is the mean of the
+        microbatch means. Slots anywhere ``_train_step_body`` does,
+        including under the K-step fused dispatch."""
+        grad_eval = self._grad_eval_body()
+        tx = self.tx
+        metric_names = tuple(name for name, _ in self.metric_fns)
+        # Same CPU unroll rationale as _get_multi_step_train_step: XLA:CPU
+        # runs while-loop bodies ~2x slower than straight-line code.
+        unroll_full = self._device_platform() == "cpu"
+
+        def zeros_acc(p):
+            # f32 accumulator for floating grads (bf16 partial sums over M
+            # microbatches would lose the low bits the big batch keeps).
+            if jnp.issubdtype(jnp.result_type(p), jnp.floating):
+                return jnp.zeros(p.shape, jnp.float32)
+            return jnp.zeros_like(p)
+
+        def step(params, state, opt_state, xs, ys, rng):
+            def one(carry, slice_i):
+                gsum, state, loss_sum, msums = carry
+                x, y, i = slice_i
+                loss, state, grads, mvals = grad_eval(
+                    params, state, x, y, jax.random.fold_in(rng, i)
+                )
+                gsum = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(a.dtype), gsum, grads
+                )
+                loss_sum = loss_sum + jnp.float32(loss)
+                msums = tuple(
+                    (s + jnp.float32(mvals[n][0]),
+                     c + jnp.float32(mvals[n][1]))
+                    for (s, c), n in zip(msums, metric_names)
+                )
+                return (gsum, state, loss_sum, msums), None
+
+            init = (
+                jax.tree_util.tree_map(zeros_acc, params),
+                state,
+                jnp.float32(0.0),
+                tuple(
+                    (jnp.float32(0.0), jnp.float32(0.0))
+                    for _ in metric_names
+                ),
+            )
+            (gsum, new_state, loss_sum, msums), _ = jax.lax.scan(
+                one, init, (xs, ys, jnp.arange(m)),
+                unroll=m if unroll_full else 1,
+            )
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / m).astype(jnp.result_type(p)), gsum, params
+            )
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
-            return new_params, new_state, new_opt, loss, mvals
+            new_params, new_opt = _constrain_step_outputs(new_params, new_opt)
+            mvals = {n: p for n, p in zip(metric_names, msums)}
+            return new_params, new_state, new_opt, loss_sum / m, mvals
 
         return step
 
-    def _get_multi_step_train_step(self):
+    def _get_accum_train_step(self, m: int):
+        fn = self._accum_train_steps.get(m)
+        if fn is None:
+            fn = self._scoped(
+                jax.jit(self._accum_train_step_body(m), donate_argnums=(0, 1, 2))
+            )
+            self._accum_train_steps[m] = fn
+        return fn
+
+    def _get_multi_step_train_step(self, accum_m: int = 1):
         """Fused K-step dispatch for compile(steps_per_execution=K): one
         jitted ``lax.scan`` over the leading axis of a ``[K, batch, ...]``
         super-batch, running the SAME per-step body the K=1 path jits
@@ -459,14 +567,30 @@ class Model:
         the in-place reuse the straight-line program gets), which would
         eat the entire dispatch saving. Accelerator backends keep the
         rolled loop: the carry stays in place there and compile time stays
-        O(1) in K."""
-        if self._multi_train_step is not None:
-            return self._multi_train_step
-        body = self._train_step_body()
+        O(1) in K.
+
+        ``accum_m > 1`` composes ``fit(grad_accum=M)`` with the fused
+        dispatch: the per-step body becomes the M-microbatch accumulation
+        scan, and the super-batch arrives as ``[K*M, micro, ...]`` (one
+        stacked placement), reshaped to ``[K, M, micro, ...]`` in-trace —
+        K optimizer steps per dispatch, each from M accumulated
+        microbatch gradients."""
+        cached = self._multi_train_steps.get(accum_m)
+        if cached is not None:
+            return cached
+        body = (
+            self._train_step_body() if accum_m == 1
+            else self._accum_train_step_body(accum_m)
+        )
         metric_names = tuple(name for name, _ in self.metric_fns)
         unroll_full = self._device_platform() == "cpu"
 
         def multi(params, state, opt_state, xs, ys, base_rng, step0):
+            k = xs.shape[0] // accum_m
+            if accum_m > 1:
+                xs = xs.reshape((k, accum_m) + xs.shape[1:])
+                ys = ys.reshape((k, accum_m) + ys.shape[1:])
+
             def one(carry, slice_i):
                 params, state, opt_state, loss_sum, msums = carry
                 x, y, i = slice_i
@@ -488,16 +612,15 @@ class Model:
                 ),
             )
             (params, state, opt_state, loss_sum, msums), _ = jax.lax.scan(
-                one, init, (xs, ys, jnp.arange(xs.shape[0])),
-                unroll=xs.shape[0] if unroll_full else 1,
+                one, init, (xs, ys, jnp.arange(k)),
+                unroll=k if unroll_full else 1,
             )
             mvals = {n: m for n, m in zip(metric_names, msums)}
             return params, state, opt_state, loss_sum, mvals
 
-        self._multi_train_step = self._scoped(
-            jax.jit(multi, donate_argnums=(0, 1, 2))
-        )
-        return self._multi_train_step
+        fn = self._scoped(jax.jit(multi, donate_argnums=(0, 1, 2)))
+        self._multi_train_steps[accum_m] = fn
+        return fn
 
     def _device_platform(self) -> str:
         """Platform ('cpu'/'tpu'/...) of the devices this model's strategy
@@ -650,8 +773,26 @@ class Model:
         seed: Optional[int] = None,
         callbacks: Sequence = (),
         prefetch: Optional[int] = None,
+        grad_accum: Optional[int] = None,
     ) -> History:
-        """``prefetch``: device-prefetch depth — how many dispatches' input
+        """``grad_accum=M``: split every optimizer step's ``batch_size``
+        rows into M equal microbatches, run the M forward/backward passes
+        sequentially ON DEVICE (a ``lax.scan`` inside the jitted step),
+        accumulate the gradients in f32, and apply ONE optimizer update
+        with their mean — the update the full batch would have taken, at
+        the activation memory of ``batch_size / M`` rows. This is how the
+        GLOBAL batch grows past what HBM fits in one shot: losses match
+        the equivalent big batch to f32 summation order (bit-exact per
+        microbatch, the cross-microbatch mean regroups the reduction),
+        and ``tests/test_zero.py`` pins the parity. ``model.step``,
+        callbacks, and LR schedules all advance per OPTIMIZER step (not
+        per microbatch), unlike ``compile(gradient_accumulation_steps=N)``
+        (optax.MultiSteps), which accumulates across N full-size ``fit``
+        steps. Composes with ``compile(steps_per_execution=K)``: one
+        dispatch stages ``[K*M, micro, ...]`` and runs K accumulated
+        updates.
+
+        ``prefetch``: device-prefetch depth — how many dispatches' input
         may be staged (host-prepped AND placed on device) ahead of the one
         executing, by a bounded background producer
         (``data.DevicePrefetcher``). Donated dispatches block the host for
@@ -710,7 +851,21 @@ class Model:
                 raise ValueError(f"batch_size {batch_size} > dataset size {n}")
             if steps_per_epoch is None:
                 steps_per_epoch = n // batch_size
-        self.strategy.local_batch_size(batch_size)  # divisibility check
+        if grad_accum is not None and (
+            not isinstance(grad_accum, (int, np.integer)) or grad_accum < 1
+        ):
+            raise ValueError(
+                f"grad_accum must be an integer >= 1, got {grad_accum!r}"
+            )
+        accum_m = int(grad_accum) if grad_accum else 1
+        if batch_size % accum_m:
+            raise ValueError(
+                f"grad_accum={accum_m} must divide batch_size {batch_size} "
+                "(each optimizer step's batch splits into M equal "
+                "microbatches)"
+            )
+        micro = batch_size // accum_m
+        self.strategy.local_batch_size(micro)  # replica divisibility check
         if (
             validation_data is not None
             and hasattr(validation_data, "__next__")
@@ -725,7 +880,13 @@ class Model:
                 "data.Pipeline, default to one pass)"
             )
         multi_k = self.steps_per_execution or 1
-        step_fn = self._get_train_step() if multi_k == 1 else None
+        if multi_k == 1:
+            step_fn = (
+                self._get_train_step() if accum_m == 1
+                else self._get_accum_train_step(accum_m)
+            )
+        else:
+            step_fn = None
         if prefetch is None:
             prefetch = int(os.environ.get("DTPU_PREFETCH_DEPTH", "2"))
         prefetch = max(0, int(prefetch))
@@ -826,6 +987,21 @@ class Model:
 
                 def stage(k):
                     xb, yb = next_batch()
+                    if accum_m > 1:
+                        # One optimizer step's batch as a [M, micro, ...]
+                        # stack: leading microbatch axis replicated, rows
+                        # (dim 1) sharded — the multi-step super-batch
+                        # placement, reused verbatim. shape[0] (not the
+                        # global micro size) so per-host row shards
+                        # reshape to THEIR slice of each microbatch.
+                        xb, yb = np.asarray(xb), np.asarray(yb)
+                        mb = xb.shape[0] // accum_m
+                        xb = xb.reshape((accum_m, mb) + xb.shape[1:])
+                        yb = yb.reshape((accum_m, mb) + yb.shape[1:])
+                        return self.strategy.put_batch(
+                            {"x": xb, "y": yb}, per_host=per_host,
+                            stacked=True, async_=True,
+                        )
                     return self.strategy.put_batch(
                         {"x": xb, "y": yb}, per_host=per_host, async_=True
                     )
@@ -835,11 +1011,20 @@ class Model:
                 while left > 0:
                     sizes.append(min(multi_k, left))
                     left -= sizes[-1]
-                multi_fn = self._get_multi_step_train_step()
+                multi_fn = self._get_multi_step_train_step(accum_m)
                 base_rng = jax.random.PRNGKey(self._seed + 1)
 
                 def stage(k):
                     xs, ys = next_k_batches(k)
+                    if accum_m > 1:
+                        # [k, batch, ...] -> [k*M, micro, ...]: one stacked
+                        # placement stages k optimizer steps x M
+                        # microbatches; the jitted dispatch reshapes the
+                        # leading axis back to [k, M].
+                        xs, ys = np.asarray(xs), np.asarray(ys)
+                        mb = xs.shape[1] // accum_m
+                        xs = xs.reshape((k * accum_m, mb) + xs.shape[2:])
+                        ys = ys.reshape((k * accum_m, mb) + ys.shape[2:])
                     return self.strategy.put_batch(
                         {"x": xs, "y": ys}, per_host=per_host, stacked=True,
                         async_=True,
@@ -983,7 +1168,18 @@ class Model:
             # train-end wait() (flushing a background writer) attributes
             # its blocked time to checkpoint_wait and must be counted.
             cb.on_train_end(self, history)
-        self.last_fit_telemetry = timer.stall_report()
+        report = timer.stall_report()
+        # Device-memory telemetry: the allocator's peak/current bytes when
+        # the backend exposes them (HBM backends do; XLA:CPU reports None)
+        # plus the measured per-device model-state footprint (params +
+        # state + opt_state, from shard buffer sizes — exact on every
+        # backend, and the number ZeRO sharding exists to shrink).
+        from ..utils.profiler import device_memory_stats, tree_bytes_per_device
+        report["device_memory"] = device_memory_stats()
+        report["model_state_bytes_per_device"] = tree_bytes_per_device(
+            self.params, self.state, self.opt_state
+        )["max_bytes_per_device"]
+        self.last_fit_telemetry = report
         self._stall_timer = None
         return history
 
@@ -1373,7 +1569,8 @@ class Model:
         # Placements (and possibly dtypes) changed: every cached compiled
         # step is stale, as is the memoized decode dtype (mirrors build()).
         self._train_step = self._eval_step = self._predict_step = None
-        self._multi_train_step = None
+        self._multi_train_steps = {}
+        self._accum_train_steps = {}
         self._decode_dtype = None
         self._generate_fns = {}
         if self.compiled:

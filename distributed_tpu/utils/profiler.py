@@ -39,6 +39,55 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def device_memory_stats(device=None):
+    """Allocator stats of one device as ``{bytes_in_use, peak_bytes_in_use,
+    bytes_limit}`` — the numbers a ZeRO/FSDP run watches to know how close
+    to the HBM ceiling it sits. Reads ``device.memory_stats()`` (default:
+    ``jax.local_devices()[0]``); returns None on backends without an
+    instrumented allocator (XLA:CPU, including the simulated-device test
+    mesh) — use :func:`tree_bytes_per_device` there for the model-state
+    share, which is the part sharding controls anyway."""
+    d = device if device is not None else jax.local_devices()[0]
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": int(
+            stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+        ),
+    }
+    if "bytes_limit" in stats:
+        out["bytes_limit"] = int(stats["bytes_limit"])
+    return out
+
+
+def tree_bytes_per_device(*trees) -> dict:
+    """Measured per-device resident bytes of pytrees of arrays, from the
+    size of each ``jax.Array``'s addressable shard buffers (no transfers,
+    no allocator needed — works on every backend, including the CPU sim).
+    Replicated leaves count once PER DEVICE (that is the cost replication
+    pays and sharding avoids); host numpy leaves are skipped. Returns
+    ``{"max_bytes_per_device", "total_bytes", "devices"}`` where
+    ``total_bytes`` sums over all devices."""
+    per: dict = {}
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not isinstance(leaf, jax.Array):
+                continue
+            for s in leaf.addressable_shards:
+                key = str(s.device)
+                per[key] = per.get(key, 0) + int(s.data.nbytes)
+    return {
+        "max_bytes_per_device": max(per.values()) if per else 0,
+        "total_bytes": sum(per.values()),
+        "devices": len(per),
+    }
+
+
 class StepTimer:
     """Steps/sec measurement with warmup exclusion; emits structured events.
 

@@ -12,16 +12,31 @@
 #   - DOTS_PASSED=<n> printed at the end: the per-test tally survives a
 #     timeout kill (pytest's own summary would not), and the incremental
 #     ledger .pytest_progress.txt names every completed test either way
-#   - exit status is pytest's (or 124 on timeout), NOT tee's
+#   - --durations=15 prints the slowest tests so a PR that bloats the
+#     suite names its own culprits
+#   - TIER1_WALL_SECONDS=<n> printed at the end; a PASSING run that takes
+#     longer than 850 s FAILS anyway (exit 3): the hard timeout is 870 s,
+#     and a suite that creeps past 850 s leaves the next PR no room to
+#     add a single test — fail loud here, not mysteriously there
+#   - exit status is pytest's (or 124 on timeout, 3 on budget), NOT tee's
 
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
+BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"
+start=$(date +%s)
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m 'not slow' --continue-on-collection-errors \
+    -m 'not slow' --continue-on-collection-errors --durations=15 \
     -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
+elapsed=$(( $(date +%s) - start ))
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
+echo "TIER1_WALL_SECONDS=$elapsed"
+if [ "$rc" -eq 0 ] && [ "$elapsed" -gt "$BUDGET" ]; then
+    echo "tier-1 wall time ${elapsed}s exceeds the ${BUDGET}s budget" \
+         "(hard timeout is 870s; trim or @slow-mark tests)" >&2
+    rc=3
+fi
 exit "$rc"
