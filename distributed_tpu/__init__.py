@@ -25,7 +25,8 @@ Quickstart (the reference's local->distributed 6-line-diff contract):
     model.fit(x, y, batch_size=64 * strategy.num_replicas_in_sync, epochs=3)
 """
 
-from . import cluster, data, models, nn, ops, optim, parallel, utils
+from . import cluster, data, models, nn, ops, optim, parallel, precision, utils
+from .precision import Policy
 from .checkpoint import Checkpointer, ShardedCheckpointer, export_hdf5, import_hdf5
 from .training import callbacks
 from . import resilience  # after training/checkpoint: builds on both
@@ -74,6 +75,8 @@ __all__ = [
     "nn",
     "ops",
     "optim",
+    "precision",
+    "Policy",
     "losses",
     "metrics",
     "models",

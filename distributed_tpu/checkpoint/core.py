@@ -261,6 +261,19 @@ class Checkpointer:
     back data-sharded (and vice versa), and ``inject_hyperparams``
     wrappers round-trip their live values (a runtime-set learning rate
     survives the resume; tests/test_zero.py pins both).
+    Checkpoints are also PRECISION-PORTABLE: a mixed-precision policy
+    (``compile(precision=...)``) keeps params and optimizer state as f32
+    master weights — the compute-dtype cast lives inside the jitted step,
+    never in ``model.params`` — so what lands on disk is f32 under every
+    mixed preset, and saving under ``mixed_bfloat16`` then restoring
+    under ``float32`` (or vice versa) is byte-exact. The one structural
+    caveat: ``mixed_float16``'s dynamic loss scale is real optimizer
+    state (``optim.LossScaleState``, outermost), so its live scale
+    survives same-policy round-trips, but crossing between a
+    loss-scaling and a non-scaling policy changes the optimizer-state
+    leaf count and raises the format error below (keep the weights via
+    ``save_weights``/``load_weights`` in that case;
+    tests/test_precision.py pins the round-trips).
     When the newest file is corrupt anyway (torn by the filesystem, or a
     fault-injection test), auto-restore skips it and falls back to the
     previous step instead of failing the relaunch.

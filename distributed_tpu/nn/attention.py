@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from . import initializers
 from .core import Layer, Shape
+from ..precision import resolve_dtype
 
 
 class MultiHeadAttention(Layer):
@@ -189,8 +190,9 @@ class MultiHeadAttention(Layer):
 
     def _proj(self, params, x, w, b):
         kernel = params[w]
-        if self.dtype is not None:
-            kernel = kernel.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            kernel = kernel.astype(dt)
         y = jnp.dot(x, kernel)
         if self.use_bias:
             y = y + params[b].astype(y.dtype)
@@ -221,8 +223,9 @@ class MultiHeadAttention(Layer):
                 "(MultiHeadAttention(causal=True)); bidirectional models "
                 "have no autoregressive decode"
             )
-        if self.dtype is not None:
-            x = x.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
         b = x.shape[0]
         h = self.num_heads
         hd = params["wq"].shape[1] // h
@@ -251,8 +254,9 @@ class MultiHeadAttention(Layer):
         return out, {"k": ck, "v": cv}
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        if self.dtype is not None:
-            x = x.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
         b, t, _ = x.shape
         h = self.num_heads
         hd = params["wq"].shape[1] // h  # robust if apply runs on a fresh instance

@@ -92,6 +92,17 @@ class Layer:
         translate roles into PartitionSpecs. Empty = fully replicated."""
         return {}
 
+    def dtype_hints(self):
+        """Explicit per-layer compute-dtype overrides, mirroring the params
+        tree the way ``sharding_hints`` does: a layer constructed with
+        ``dtype=...`` reports that dtype; containers nest children under
+        their names. ``Policy.cast_to_compute`` skips the marked subtrees,
+        so an explicitly-dtyped layer keeps master-precision params and
+        performs its own cast — per-layer ``dtype=`` overrides the policy
+        exactly. None/{} = no override (the policy's compute dtype
+        applies)."""
+        return getattr(self, "dtype", None)
+
     def default_name(self) -> str:
         return _camel_to_snake(type(self).__name__)
 
@@ -225,6 +236,14 @@ class Sequential(Layer):
                 hints[layer.name] = h
         return hints
 
+    def dtype_hints(self):
+        hints = {}
+        for layer in self.layers:
+            h = layer.dtype_hints()
+            if h is not None and h != {}:
+                hints[layer.name] = h
+        return hints
+
     def apply(self, params, state, x, *, train=False, rng=None):
         return apply_layers(
             self.layers, params, state, x, train=train, rng=rng
@@ -329,6 +348,17 @@ class Residual(Layer):
         if self.shortcut is not None:
             h = self.shortcut.sharding_hints()
             if h:
+                hints["shortcut"] = h
+        return hints
+
+    def dtype_hints(self):
+        hints = {}
+        h = self.main.dtype_hints()
+        if h is not None and h != {}:
+            hints["main"] = h
+        if self.shortcut is not None:
+            h = self.shortcut.dtype_hints()
+            if h is not None and h != {}:
                 hints["shortcut"] = h
         return hints
 

@@ -24,6 +24,7 @@ from jax import lax
 
 from . import activations, initializers
 from .core import Layer, Shape
+from ..precision import resolve_dtype
 
 IntOr2 = Union[int, Tuple[int, int]]
 
@@ -86,9 +87,10 @@ class Conv2D(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         kernel = params["kernel"]
-        if self.dtype is not None:
-            x = x.astype(self.dtype)
-            kernel = kernel.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
+            kernel = kernel.astype(dt)
         y = lax.conv_general_dilated(
             x,
             kernel,
@@ -148,9 +150,10 @@ class Dense(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         kernel = params["kernel"]
-        if self.dtype is not None:
-            x = x.astype(self.dtype)
-            kernel = kernel.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
+            kernel = kernel.astype(dt)
         y = jnp.dot(x, kernel)
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
@@ -494,6 +497,7 @@ class Embedding(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         table = params["table"]
-        if self.dtype is not None:
-            table = table.astype(self.dtype)
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            table = table.astype(dt)
         return jnp.take(table, x, axis=0), {}

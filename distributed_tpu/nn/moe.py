@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from . import core, initializers
 from .core import Layer, Shape
+from ..precision import resolve_dtype
 
 
 class MoE(Layer):
@@ -200,7 +201,7 @@ class MoE(Layer):
                              pos_onehot)
 
         # Expert buffers: (G, e, cap, d) -> MLP -> back. All MXU einsums.
-        compute_dtype = self.dtype or tokens.dtype
+        compute_dtype = resolve_dtype(self.dtype) or tokens.dtype
         buf = jnp.einsum(
             "Gnec,Gnd->Gecd", dispatch.astype(compute_dtype),
             tokens.astype(compute_dtype),
@@ -257,7 +258,7 @@ class MoE(Layer):
             "nk,nke->ne", gate_vals,
             jax.nn.one_hot(gate_idx, e, dtype=jnp.float32),
         )  # (N, e)
-        compute_dtype = self.dtype or x.dtype
+        compute_dtype = resolve_dtype(self.dtype) or x.dtype
         h = act(
             jnp.einsum("nd,edh->neh", flat.astype(compute_dtype),
                        params["w_in"].astype(compute_dtype))

@@ -142,6 +142,12 @@ class PipelinedBlocks(Layer):
         # leading (stage) dim over the 'pipe' mesh axis.
         return {"blocks": "pipe"}
 
+    def dtype_hints(self):
+        # Same pass-through as ScannedBlocks: stacked params mirror the
+        # template block's tree one level down.
+        h = self.block.dtype_hints()
+        return {"blocks": h} if h is not None and h != {} else {}
+
     # ------------------------------------------------------------------ apply
     def _stage_rngs(self, rng):
         if rng is None:

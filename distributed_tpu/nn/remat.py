@@ -74,6 +74,9 @@ class Remat(Layer):
     def sharding_hints(self):
         return self.inner.sharding_hints()
 
+    def dtype_hints(self):
+        return self.inner.dtype_hints()
+
     def param_spec(self, input_shape):
         return self.inner.param_spec(input_shape)
 

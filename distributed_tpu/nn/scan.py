@@ -198,6 +198,12 @@ class ScannedBlocks(Layer):
         inner = shift(self.block.sharding_hints())
         return {"blocks": inner} if inner else {}
 
+    def dtype_hints(self):
+        # Stacked params mirror the template block's tree one level down,
+        # so its explicit per-layer dtype overrides pass straight through.
+        h = self.block.dtype_hints()
+        return {"blocks": h} if h is not None and h != {} else {}
+
     def init(self, key, input_shape: Shape):
         shape = tuple(input_shape)
         params, state = init_stacked_blocks(

@@ -16,6 +16,10 @@ re-evaluated against the step count inside the update, as before.
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -104,6 +108,104 @@ def get_hyperparam(opt_state, name: str):
     """Read an injected hyperparameter from ``opt_state`` (see
     ``set_hyperparam``)."""
     return _tree_get(opt_state, name)
+
+
+class LossScaleState(NamedTuple):
+    """State of ``dynamic_loss_scaling``: the live scale (f32 scalar), the
+    count of consecutive finite steps since the last scale change, and the
+    wrapped transform's state. A NamedTuple pytree, so it shards/replicates
+    with the usual NamedSharding rules, checkpoints leaf-for-leaf (the live
+    scale survives save/restore), and stays transparent to
+    ``optax.tree_utils`` — ``set_hyperparam('learning_rate', ...)`` reaches
+    through it into the wrapped optimizer."""
+
+    scale: Any
+    growth_count: Any
+    inner_state: Any
+
+
+def dynamic_loss_scaling(
+    inner,
+    *,
+    init_scale: float = 2.0 ** 15,
+    growth_interval: int = 2000,
+    factor: float = 2.0,
+    min_scale: float = 1.0,
+):
+    """Dynamic-loss-scale wrapper for float16 training (the optax-style
+    half of the Micikevicius et al. 2018 recipe; bf16 does not need it).
+
+    The model's step multiplies the loss by ``state.scale`` before
+    autodiff, so the incoming gradients here are SCALED. ``update``:
+
+    1. unscales the gradients (divide by the live scale, in f32),
+    2. checks every leaf for finiteness,
+    3. finite   -> applies the wrapped transform to the unscaled grads and,
+       after ``growth_interval`` consecutive finite steps, doubles the
+       scale (``factor``),
+    4. non-finite -> SKIPS the step: zero updates, the wrapped state is
+       kept (not advanced), and the scale is halved (floored at
+       ``min_scale``).
+
+    The skip keeps params and optimizer statistics untouched while the
+    scale searches back down to the representable range — overflow costs
+    one step of progress, never a poisoned Adam moment."""
+    inner = get(inner)
+
+    def init_fn(params):
+        return LossScaleState(
+            jnp.float32(init_scale), jnp.int32(0), inner.init(params)
+        )
+
+    def update_fn(grads, state, params=None):
+        inv = jnp.float32(1.0) / state.scale
+        unscaled = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype)
+            if jnp.issubdtype(jnp.result_type(g), jnp.floating) else g,
+            grads,
+        )
+        leaves = jax.tree_util.tree_leaves(unscaled)
+        finite = jnp.array(True)
+        for leaf in leaves:
+            if jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+        new_updates, new_inner = inner.update(
+            unscaled, state.inner_state, params
+        )
+        # Elementwise select: on a skipped step the zero update and the
+        # retained old inner state win; any NaN/inf in the not-taken
+        # branch is discarded by the select, never propagated.
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), new_updates
+        )
+        inner_state = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(finite, n, o), new_inner,
+            state.inner_state,
+        )
+        grown = state.growth_count + 1
+        should_grow = jnp.logical_and(finite, grown >= growth_interval)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(should_grow, state.scale * factor, state.scale),
+            jnp.maximum(state.scale / factor, jnp.float32(min_scale)),
+        )
+        new_count = jnp.where(
+            jnp.logical_and(finite, jnp.logical_not(should_grow)),
+            grown, jnp.int32(0),
+        )
+        return updates, LossScaleState(new_scale, new_count, inner_state)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def loss_scale_value(opt_state):
+    """The live loss scale of an optimizer state built through
+    ``dynamic_loss_scaling`` (the wrapper is always outermost), or None
+    when no loss scaling is active. Model step bodies read this to
+    multiply the loss before autodiff."""
+    if isinstance(opt_state, LossScaleState):
+        return opt_state.scale
+    return None
 
 
 def sgd_with_cosine(learning_rate: float, steps: int, warmup: int = 0, momentum: float = 0.9):

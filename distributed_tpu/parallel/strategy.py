@@ -119,6 +119,54 @@ class Strategy:
         step 2 on."""
         return params, opt_state
 
+    def constrain_compute_params(self, params):
+        """Trace-time hook on the COMPUTE-DTYPE copy of the params a mixed-
+        precision step builds (``Policy.cast_to_compute`` inside the jitted
+        body). Strategies that shard params (FSDP family) pin the cast copy
+        to the SAME shard layout as the f32 masters, so the per-layer
+        all-gathers GSPMD inserts happen AFTER the cast and move
+        compute-dtype bytes — under bf16 that halves the dominant FSDP
+        collective. Identity by default (replicated params gather
+        nothing)."""
+        return params
+
+    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+        """Analytic per-step, per-device collective-traffic estimate for
+        the parameter-sized collectives this strategy emits, at the dtype
+        the bytes actually move in (``compute_dtype`` under a mixed-
+        precision policy, else the leaves' own dtype). Keys:
+
+        - ``gathered_param_bytes_per_device``: one full gather of the
+          strategy's sharded parameter state per step (FSDP: the per-layer
+          forward all-gather, repeated for backward but counted once so
+          the number stays a comparable "bytes of one gather"; ZeRO-1: the
+          post-update all-gather of the parameter updates, at MASTER dtype
+          — the update applies to f32 params).
+        - ``grad_reduce_bytes_per_device``: the gradient all-reduce /
+          reduce-scatter, one param-tree's worth of bytes.
+
+        An estimate, not a measurement (ring-collective (N-1)/N factors
+        and XLA fusion are ignored): its job is to make the MIXED vs f32
+        traffic ratio visible in telemetry/bench, which those constant
+        factors cancel out of. Base strategy emits no collectives."""
+        return {
+            "gathered_param_bytes_per_device": 0,
+            "grad_reduce_bytes_per_device": 0,
+        }
+
+    @staticmethod
+    def _leaf_comm_bytes(leaf, compute_dtype=None) -> int:
+        """Bytes one parameter leaf contributes to a collective when moved
+        at ``compute_dtype`` (floating leaves only; others keep their own
+        dtype)."""
+        import jax.numpy as jnp
+
+        size = int(np.prod(leaf.shape)) if getattr(leaf, "shape", None) else 1
+        dt = jnp.result_type(leaf)
+        if compute_dtype is not None and jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.dtype(compute_dtype)
+        return size * jnp.dtype(dt).itemsize
+
     def put_batch(self, batch, per_host: bool = False,
                   stacked: bool = False, async_: bool = False):
         """Place a numpy batch onto devices. ``per_host=True`` means each
@@ -252,6 +300,19 @@ class DataParallel(Strategy):
             )
         return global_batch // n
 
+    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+        # Replicated DP: one gradient all-reduce of the full param tree per
+        # step; the cotangents it moves are compute-dtype under a mixed
+        # policy (the f32 cast-back to masters happens per device).
+        grad = sum(
+            self._leaf_comm_bytes(l, compute_dtype)
+            for l in jax.tree_util.tree_leaves(params)
+        )
+        return {
+            "gathered_param_bytes_per_device": 0,
+            "grad_reduce_bytes_per_device": grad,
+        }
+
 
 class ZeroDataParallel(DataParallel):
     """ZeRO-1 data parallelism: params replicated, optimizer state sharded
@@ -307,6 +368,19 @@ class ZeroDataParallel(DataParallel):
             opt_state,
         )
         return params, opt_state
+
+    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+        # DP's gradient all-reduce (compute-dtype bytes under a mixed
+        # policy) plus ZeRO-1's post-update all-gather of the parameter
+        # updates — which applies to the f32 MASTERS, so those bytes do
+        # NOT shrink under a reduced compute dtype.
+        out = super().comm_bytes_estimate(params, compute_dtype)
+        out["gathered_param_bytes_per_device"] = sum(
+            self._leaf_comm_bytes(l, None)
+            for l in jax.tree_util.tree_leaves(params)
+            if self._shardable(l) and self._opt_spec(l.shape) != PartitionSpec()
+        )
+        return out
 
 
 def _check_pipe_divisible(params, hints, n: int, axis_name: str):
@@ -581,6 +655,41 @@ class FullyShardedDataParallel(_HintedParallel):
             jax.tree_util.tree_map(pin, params),
             jax.tree_util.tree_map(pin, opt_state),
         )
+
+    def constrain_compute_params(self, params):
+        """Pin the compute-dtype param copy to the SAME per-shape ZeRO
+        shard spec as the f32 masters. Without the pin, GSPMD is free to
+        gather the f32 masters first and cast afterwards; with it, the
+        f32->compute cast runs shard-local and the per-layer all-gathers
+        move compute-dtype bytes — half the FSDP traffic under bf16."""
+        def pin(a):
+            if getattr(a, "ndim", 0) < 1:
+                return a
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(self.mesh, self._spec_for(a.shape))
+            )
+
+        return jax.tree_util.tree_map(pin, params)
+
+    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+        # ZeRO-3: every sharded parameter is all-gathered before use (one
+        # full gather counted; the backward re-gather doubles it in
+        # practice) and the gradients reduce-scatter back — both at
+        # compute dtype under a mixed policy, which is THE mixed-precision
+        # comms win this estimate exists to expose.
+        gathered = sum(
+            self._leaf_comm_bytes(l, compute_dtype)
+            for l in jax.tree_util.tree_leaves(params)
+            if getattr(l, "ndim", 0) >= 1
+            and self._spec_for(l.shape) != PartitionSpec()
+        )
+        return {
+            "gathered_param_bytes_per_device": gathered,
+            "grad_reduce_bytes_per_device": sum(
+                self._leaf_comm_bytes(l, compute_dtype)
+                for l in jax.tree_util.tree_leaves(params)
+            ),
+        }
 
 
 class FSDP(FullyShardedDataParallel):

@@ -34,6 +34,7 @@ import numpy as np
 import optax
 
 from .. import optim
+from .. import precision as precision_lib
 from ..nn.core import (
     Layer,
     apply_layers as _apply_layers,
@@ -72,6 +73,24 @@ def _constrain_step_outputs(params, opt_state):
     if strat is None:
         return params, opt_state
     return strat.constrain_step(params, opt_state)
+
+
+def _cast_for_compute(policy, params, dtype_hints):
+    """Master->compute param cast for one forward/backward pass under a
+    mixed-precision policy (identity without one, or when compute ==
+    param dtype). The cast happens IN-TRACE on the f32 masters, so
+    gradients flow back to f32 through the cast's VJP; ``dtype_hints``
+    exempts explicitly-dtyped layers (they cast their own params, keeping
+    per-layer ``dtype=`` overrides exact); and the ambient strategy may
+    pin the cast copy to its shard layout (``constrain_compute_params``)
+    so FSDP-family all-gathers move compute-dtype bytes."""
+    if policy is None or not policy.needs_compute_cast:
+        return params
+    cast = policy.cast_to_compute(params, dtype_hints)
+    strat = current_strategy()
+    if strat is not None:
+        cast = strat.constrain_compute_params(cast)
+    return cast
 
 
 def _aux_loss_sum(state):
@@ -139,6 +158,8 @@ class Model:
         self.step = 0  # global optimizer step (checkpoint/resume cursor)
         self.head_chunks = None  # compile(head_chunks=C): chunked head-loss
         self.steps_per_execution = None  # compile(steps_per_execution=K)
+        self.precision = None  # compile(precision=...): dtype Policy
+        self._dtype_hints = {}  # per-layer dtype= overrides, set by build()
         self.stop_training = False  # callbacks (EarlyStopping) set this
         self._resumed_step = None  # set by a restoring ModelCheckpoint
         self._stall_timer = None  # live StepTimer of the fit in progress
@@ -164,6 +185,14 @@ class Model:
         # Tensor-parallel role tree (empty for unhinted models); strategies
         # without a model axis ignore it.
         self._param_hints = self.module.sharding_hints()
+        # Per-layer explicit dtype= overrides: Policy.cast_to_compute skips
+        # these subtrees so the layer's own cast wins over the policy.
+        self._dtype_hints = self.module.dtype_hints()
+        if self.precision is not None:
+            # Master-weight storage dtype (f32 for every mixed_* preset,
+            # so this is a no-op there; a custom all-low-precision policy
+            # casts here, at build).
+            params = self.precision.cast_params_to_storage(params)
         self.params = self.strategy.put_params(params, hints=self._param_hints)
         self.state = self.strategy.put_params(state)
         if self.compiled:
@@ -182,6 +211,7 @@ class Model:
         gradient_accumulation_steps: Optional[int] = None,
         head_chunks: Optional[int] = None,
         steps_per_execution: Optional[int] = None,
+        precision=None,
         **optimizer_kwargs,
     ):
         """``head_chunks=C``: fused chunked head-loss for token models.
@@ -228,7 +258,28 @@ class Model:
         order, same per-step RNG fold). Callbacks, the progress line, and
         ``model.step`` advance at K-step granularity; validation is
         unaffected (evaluate already syncs once per call). Composes with
-        ``head_chunks`` and ``gradient_accumulation_steps``."""
+        ``head_chunks`` and ``gradient_accumulation_steps``.
+
+        ``precision``: a mixed-precision dtype policy — ``"float32"``
+        (explicit f32 policy), ``"mixed_bfloat16"`` (bf16 compute, f32
+        master weights — the TPU-native mode: ~2x MXU rate, half the
+        activation/collective bytes, no loss scaling needed),
+        ``"mixed_float16"`` (f16 compute + dynamic loss scaling, for
+        f16-only backends), or a ``precision.Policy``. Params and
+        optimizer state stay f32 (master weights) under the mixed
+        presets: every jitted step casts the params once to the compute
+        dtype for the forward/backward pass, gradients come back f32
+        through the cast's VJP, and the update applies to the masters —
+        so checkpoints always persist f32 and a policy change between
+        save and restore round-trips cleanly. Loss/metric accumulation
+        keeps its existing f32 paths; per-layer ``dtype=`` still
+        overrides the policy for that layer. Under ``FSDP`` /
+        ``ZeroDataParallel`` the compute cast happens before the
+        sharding-constraint-driven all-gathers, halving the per-layer
+        param-gather traffic under bf16 (docs/PERF.md "Mixed
+        precision"). ``None`` (default) disables the policy machinery
+        entirely — the pre-policy f32 behavior, byte-for-byte."""
+        self.precision = precision_lib.get(precision)
         self.tx = optim.get(optimizer, **optimizer_kwargs)
         if grad_clip is not None:
             if grad_clip <= 0:
@@ -245,6 +296,17 @@ class Model:
                 )
             if n > 1:
                 self.tx = optax.MultiSteps(self.tx, every_k_schedule=int(n))
+        if self.precision is not None and self.precision.loss_scaling:
+            # Outermost wrapper: the step body reads opt_state.scale to
+            # multiply the loss before autodiff, and the wrapper unscales
+            # + finite-checks the gradients before anything else (clip,
+            # accumulation, the optimizer) sees them.
+            self.tx = optim.dynamic_loss_scaling(
+                self.tx,
+                init_scale=self.precision.initial_loss_scale,
+                growth_interval=self.precision.loss_scale_growth_interval,
+                factor=self.precision.loss_scale_factor,
+            )
         self.loss_fn = losses_lib.get(loss)
         self.metric_fns = [(metrics_lib.name_of(m), metrics_lib.get(m)) for m in metrics]
         if head_chunks is not None:
@@ -267,9 +329,14 @@ class Model:
             int(steps_per_execution) if steps_per_execution else None
         )
         self.compiled = True
-        self._train_step = self._eval_step = None
+        # Every cached compiled function depends on the (loss, metrics,
+        # optimizer, precision) configuration set here — including predict
+        # and the generate scans, whose compute dtype follows the policy.
+        self._train_step = self._eval_step = self._predict_step = None
         self._multi_train_steps = {}
         self._accum_train_steps = {}
+        self._decode_dtype = None
+        self._generate_fns = {}
         if self.built:
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self
@@ -323,8 +390,12 @@ class Model:
         tx = self.tx
 
         def step(params, state, opt_state, x, y, rng):
+            # Under mixed_float16 the live loss scale rides in the
+            # (outermost) optimizer state; the loss is scaled before
+            # autodiff and the tx wrapper unscales/finite-checks.
+            scale = optim.loss_scale_value(opt_state)
             loss, new_state, grads, mvals = grad_eval(
-                params, state, x, y, rng
+                params, state, x, y, rng, scale
             )
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
@@ -343,19 +414,28 @@ class Model:
             return self._chunked_grad_eval_body()
         module, loss_fn = self.module, self.loss_fn
         metric_fns = tuple(self.metric_fns)
+        policy, dtype_hints = self.precision, self._dtype_hints
 
-        def grad_eval(params, state, x, y, rng):
+        def grad_eval(params, state, x, y, rng, scale=None):
             def loss_f(p):
-                logits, new_state = module.apply(p, state, x, train=True, rng=rng)
+                # Mixed precision: one master->compute cast of the param
+                # tree per pass; grads flow back f32 through the cast VJP.
+                pc = _cast_for_compute(policy, p, dtype_hints)
+                logits, new_state = module.apply(
+                    pc, state, x, train=True, rng=rng
+                )
+                if policy is not None:
+                    logits = policy.cast_output(logits)
                 # Layers may report auxiliary objectives (e.g. MoE router
                 # load-balance loss) through state keys named "aux_loss";
                 # they join the objective so their gradients flow.
-                return (
-                    loss_fn(logits, y) + _aux_loss_sum(new_state),
-                    (new_state, logits),
-                )
+                loss = loss_fn(logits, y) + _aux_loss_sum(new_state)
+                # Loss scaling (mixed_float16): autodiff sees scale*loss;
+                # the reported loss stays unscaled via the aux output.
+                scaled = loss if scale is None else loss * scale
+                return scaled, (loss, new_state, logits)
 
-            (loss, (new_state, logits)), grads = jax.value_and_grad(
+            (_, (loss, new_state, logits)), grads = jax.value_and_grad(
                 loss_f, has_aux=True
             )(params)
             mvals = {name: fn(logits, y) for name, fn in metric_fns}
@@ -450,19 +530,22 @@ class Model:
         """Grad-eval for compile(head_chunks=C): body applies once, the
         head + loss run chunk-by-chunk (see _chunked_head_scan)."""
         body_layers, _ = _split_head(self.module)
+        policy, dtype_hints = self.precision, self._dtype_hints
 
-        def grad_eval(params, state, x, y, rng):
+        def grad_eval(params, state, x, y, rng, scale=None):
             def loss_f(p):
+                pc = _cast_for_compute(policy, p, dtype_hints)
                 h, new_state = _apply_layers(
-                    body_layers, p, state, x, train=True, rng=rng
+                    body_layers, pc, state, x, train=True, rng=rng
                 )
                 loss_sum, n_tok, mvals = self._chunked_head_scan(
-                    p, state, h, y, None, train=True
+                    pc, state, h, y, None, train=True
                 )
                 loss = loss_sum / n_tok + _aux_loss_sum(new_state)
-                return loss, (new_state, mvals)
+                scaled = loss if scale is None else loss * scale
+                return scaled, (loss, new_state, mvals)
 
-            (loss, (new_state, mvals)), grads = jax.value_and_grad(
+            (_, (loss, new_state, mvals)), grads = jax.value_and_grad(
                 loss_f, has_aux=True
             )(params)
             return loss, new_state, grads, mvals
@@ -489,19 +572,14 @@ class Model:
         # runs while-loop bodies ~2x slower than straight-line code.
         unroll_full = self._device_platform() == "cpu"
 
-        def zeros_acc(p):
-            # f32 accumulator for floating grads (bf16 partial sums over M
-            # microbatches would lose the low bits the big batch keeps).
-            if jnp.issubdtype(jnp.result_type(p), jnp.floating):
-                return jnp.zeros(p.shape, jnp.float32)
-            return jnp.zeros_like(p)
-
         def step(params, state, opt_state, xs, ys, rng):
+            scale = optim.loss_scale_value(opt_state)
+
             def one(carry, slice_i):
                 gsum, state, loss_sum, msums = carry
                 x, y, i = slice_i
                 loss, state, grads, mvals = grad_eval(
-                    params, state, x, y, jax.random.fold_in(rng, i)
+                    params, state, x, y, jax.random.fold_in(rng, i), scale
                 )
                 gsum = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(a.dtype), gsum, grads
@@ -514,8 +592,15 @@ class Model:
                 )
                 return (gsum, state, loss_sum, msums), None
 
+            # f32 accumulator regardless of param/grad compute dtype (bf16
+            # partial sums over M microbatches would lose the low bits the
+            # equivalent big batch keeps); the shared precision helper is
+            # the single implementation, and the trace-time assert pins
+            # master-precision accumulation under any policy.
+            acc0 = precision_lib.grad_accum_init(params)
+            precision_lib.assert_f32_accumulator(acc0)
             init = (
-                jax.tree_util.tree_map(zeros_acc, params),
+                acc0,
                 state,
                 jnp.float32(0.0),
                 tuple(
@@ -527,8 +612,8 @@ class Model:
                 one, init, (xs, ys, jnp.arange(m)),
                 unroll=m if unroll_full else 1,
             )
-            grads = jax.tree_util.tree_map(
-                lambda a, p: (a / m).astype(jnp.result_type(p)), gsum, params
+            grads = precision_lib.cast_like(
+                jax.tree_util.tree_map(lambda a: a / m, gsum), params
             )
             updates, new_opt = tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(params, updates)
@@ -632,15 +717,21 @@ class Model:
         return (device or jax.devices()[0]).platform
 
     def _scoped(self, jitted):
-        """Run the jitted fn with this model's strategy as the ambient
-        strategy: jit traces on first call, and trace-time code (e.g.
-        MultiHeadAttention's ring-attention detection) reads
-        current_strategy(). Per-call cost is a thread-local set/reset."""
+        """Run the jitted fn with this model's strategy (and precision
+        policy, when compiled with one) as the ambient context: jit traces
+        on first call, and trace-time code — MultiHeadAttention's
+        ring-attention detection reads current_strategy(), layer dtype
+        resolution reads precision.current_policy(). Per-call cost is a
+        thread-local set/reset."""
         strategy = self.strategy
+        policy = self.precision
 
         def call(*args):
             with strategy.scope():
-                return jitted(*args)
+                if policy is None:
+                    return jitted(*args)
+                with policy.scope():
+                    return jitted(*args)
 
         return call
 
@@ -652,8 +743,10 @@ class Model:
         module, loss_fn = self.module, self.loss_fn
         metric_fns = tuple(self.metric_fns)
         per_ex = losses_lib.get_per_example(self.loss_fn)
+        policy, dtype_hints = self.precision, self._dtype_hints
 
         def step(params, state, x, y, mask):
+            params = _cast_for_compute(policy, params, dtype_hints)
             # Publish per-example validity to batch-statistic layers (MoE
             # routing) so pad rows neither route nor bias aux losses —
             # but only when the loss can ALSO mask per element: a custom
@@ -670,6 +763,8 @@ class Model:
                 logits, new_state = module.apply(
                     params, state, x, train=False
                 )
+            if policy is not None:
+                logits = policy.cast_output(logits)
             # Token-level models have per-element losses of shape y.shape
             # (e.g. (B, T) for an LM); the pad mask is per-example (B,).
             # Broadcast it to the label rank and count *elements*, so the
@@ -714,8 +809,10 @@ class Model:
         contract as the plain step, with the head + loss + metrics run per
         token chunk so full logits never materialize."""
         body_layers, _ = _split_head(self.module)
+        policy, dtype_hints = self.precision, self._dtype_hints
 
         def step(params, state, x, y, mask):
+            params = _cast_for_compute(policy, params, dtype_hints)
             # Same conditional as the plain eval step: weights only when
             # the loss can mask per element (see _get_eval_step).
             import contextlib
@@ -746,9 +843,13 @@ class Model:
         if self._predict_step is not None:
             return self._predict_step
         module = self.module
+        policy, dtype_hints = self.precision, self._dtype_hints
 
         def step(params, state, x):
+            params = _cast_for_compute(policy, params, dtype_hints)
             logits, _ = module.apply(params, state, x, train=False)
+            if policy is not None:
+                logits = policy.cast_output(logits)
             return logits
 
         self._predict_step = self._scoped(jax.jit(step))
@@ -1179,6 +1280,19 @@ class Model:
         report["model_state_bytes_per_device"] = tree_bytes_per_device(
             self.params, self.state, self.opt_state
         )["max_bytes_per_device"]
+        # Collective-traffic estimate at the dtype the bytes move in: a
+        # mixed policy halves FSDP's gathered-param bytes (bf16 vs f32) —
+        # the number `bench.py precision` compares across policies.
+        report["precision"] = (
+            self.precision.name if self.precision is not None else None
+        )
+        report["comm_bytes_estimate"] = self.strategy.comm_bytes_estimate(
+            self.params,
+            compute_dtype=(
+                self.precision.compute_dtype
+                if self.precision is not None else None
+            ),
+        )
         self.last_fit_telemetry = report
         self._stall_timer = None
         return history
@@ -1440,16 +1554,22 @@ class Model:
         bucket = max(64, -(-max_len // 64) * 64)
         module, params, state = self.module, self.params, self.state
         if self._decode_dtype is None:
-            # Activation dtype for the KV cache, from an abstract trace of
-            # the forward pass (the logits dtype equals the activation
-            # dtype for these models). Memoized: per built model, not per
-            # generate() call.
-            self._decode_dtype = jax.eval_shape(
-                lambda p: module.apply(
-                    p, state, jnp.zeros((1, 1), jnp.int32)
-                )[0],
-                params,
-            ).dtype
+            if self.precision is not None:
+                # Under a policy the KV-cache/activation dtype IS the
+                # policy's compute dtype — no abstract trace needed (and a
+                # bare trace would miss the scope-resolved layer dtypes).
+                self._decode_dtype = self.precision.compute_dtype
+            else:
+                # Activation dtype for the KV cache, from an abstract
+                # trace of the forward pass (the logits dtype equals the
+                # activation dtype for these models). Memoized: per built
+                # model, not per generate() call.
+                self._decode_dtype = jax.eval_shape(
+                    lambda p: module.apply(
+                        p, state, jnp.zeros((1, 1), jnp.int32)
+                    )[0],
+                    params,
+                ).dtype
         try:
             cache = module.init_cache(params, b, bucket, self._decode_dtype)
         except ValueError:
@@ -1476,7 +1596,8 @@ class Model:
             # the ambient pipe mesh, exactly as apply() picks its schedule).
             run = self._scoped(jax.jit(
                 functools.partial(
-                    _generate_scan, module, bucket, temperature, top_k
+                    _generate_scan, module, bucket, temperature, top_k,
+                    self.precision, self._dtype_hints,
                 )
             ))
         self._generate_fns[sig] = run  # (re-)insert as most recent
@@ -1596,7 +1717,7 @@ class Model:
         return text
 
 
-def _generate_scan(module, bucket, temperature, top_k,
+def _generate_scan(module, bucket, temperature, top_k, policy, dtype_hints,
                    params, state, cache, padded, t_p, n_steps, key):
     """Prefill + decode as one lax.scan (jitted per static config by
     Model.generate): teacher-force tokens < t_p (a dynamic scalar, so
@@ -1604,7 +1725,10 @@ def _generate_scan(module, bucket, temperature, top_k,
     spans the full bucketed length, but iterations past ``n_steps``
     (= requested max_len - 1, also dynamic) take a no-op ``lax.cond``
     branch, so runtime decode cost tracks the requested length, not the
-    bucket. The caller slices off the dead tail."""
+    bucket. The caller slices off the dead tail. Under a precision policy
+    the f32 master params are cast once to the compute dtype, outside the
+    scan — every decode step then reads compute-dtype weights."""
+    params = _cast_for_compute(policy, params, dtype_hints)
 
     def step(carry, t):
         def live(carry):

@@ -23,6 +23,15 @@
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 
+# TIER1_PRECISION_SMOKE=1: pre-push fast path for mixed-precision work —
+# runs ONLY tests/test_precision.py (~50 s vs the full ~800 s suite) so a
+# policy/step-body/strategy-cast change can iterate without paying for
+# tier-1 each round. NOT a tier-1 substitute: the full suite still gates.
+if [ -n "${TIER1_PRECISION_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_precision.py -q \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

@@ -82,6 +82,28 @@ def test_bench_lm_smoke():
     assert out["tflops"] > 0
 
 
+def test_bench_precision_smoke():
+    """The mixed-precision mode: tiny shapes — the real matmul-bound
+    config runs via `python bench.py precision`. The dtype assertions
+    inside bench_precision (bf16 logits from a bf16-cast tree) are part
+    of what this smoke exercises."""
+    out = bench.bench_precision(
+        vocab=64, num_layers=1, d_model=32, num_heads=2, seq_len=16,
+        batch=8, warmup=1, measure=2, windows=1,
+    )
+    assert out["precision"] == "float32" and out["value"] > 0
+    (row2,) = out["rows"]
+    assert row2["precision"] == "mixed_bfloat16"
+    assert row2["compute_dtype"] == "bfloat16"
+    assert row2["forward_logits_dtype"] == "bfloat16"
+    # masters + Adam moments stay f32 under BOTH policies: same bytes
+    assert (row2["model_state_bytes_per_device"]
+            == out["model_state_bytes_per_device"])
+    # the comms win: FSDP's gathered-param (and grad) bytes halve
+    assert out["gathered_param_bytes_ratio_f32_vs_mixed"] == 2.0
+    assert out["grad_reduce_bytes_ratio_f32_vs_mixed"] == 2.0
+
+
 def test_bench_output_contract(monkeypatch, capsys):
     """main() prints exactly one JSON line with the driver's schema."""
     monkeypatch.setattr(
