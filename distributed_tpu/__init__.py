@@ -30,6 +30,7 @@ from .precision import Policy
 from .checkpoint import Checkpointer, ShardedCheckpointer, export_hdf5, import_hdf5
 from .training import callbacks
 from . import resilience  # after training/checkpoint: builds on both
+from . import serving  # after training: Engine builds on Model
 from .ops import losses, metrics
 from .parallel.mesh import make_mesh
 from .parallel.strategy import (
@@ -86,5 +87,6 @@ __all__ = [
     "utils",
     "callbacks",
     "resilience",
+    "serving",
     "__version__",
 ]

@@ -253,6 +253,132 @@ class MultiHeadAttention(Layer):
             out = out + params["bo"].astype(out.dtype)
         return out, {"k": ck, "v": cv}
 
+    # ------------------------------------------- paged (block) KV cache --
+    # Serving-engine cache layout (serving.Engine / docs/SERVING.md): one
+    # pool of fixed-size blocks shared by every running sequence, indexed
+    # through per-slot block tables — HBM is allocated per block on
+    # demand instead of max_len per sequence, so heterogeneous lengths
+    # share the pool (vLLM-style PagedAttention). Reads gather the slot's
+    # blocks into a contiguous view and mask by the slot's position; the
+    # gather is plain XLA (no custom kernel), which is exact everywhere
+    # and leaves a Pallas gather-attention kernel as a later perf lever
+    # (ROADMAP item 4).
+
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        inner = params["wq"].shape[1]
+        hd = inner // self.num_heads
+        shape = (num_blocks, block_size, self.num_heads, hd)
+        cdtype = self.dtype or dtype
+        return {
+            "k": jnp.zeros(shape, cdtype),
+            "v": jnp.zeros(shape, cdtype),
+        }
+
+    def _paged_view(self, pool, block_tables):
+        """Gather per-slot blocks into a contiguous (S, nb*bs, H, hd) view
+        (logical position j of slot s lives at block_tables[s, j // bs],
+        offset j % bs)."""
+        gathered = pool[block_tables]  # (S, nb, bs, H, hd)
+        s, nb, bs, h, hd = gathered.shape
+        return gathered.reshape(s, nb * bs, h, hd)
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        """One-token attention for S independent slots at per-slot
+        positions: x (S, 1, D); each slot's new K/V row is scattered into
+        the pool block its position maps to, scores masked to that slot's
+        positions <= positions[s]. Inactive slots point their whole block
+        table at the engine's trash block, so their writes land harmlessly
+        outside every live sequence."""
+        if not self.causal:
+            raise NotImplementedError(
+                "incremental decode requires causal attention "
+                "(MultiHeadAttention(causal=True)); bidirectional models "
+                "have no autoregressive decode"
+            )
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
+        s = x.shape[0]
+        h = self.num_heads
+        hd = params["wq"].shape[1] // h
+        bs = cache["k"].shape[1]
+        q = self._proj(params, x, "wq", "bq").reshape(s, 1, h, hd)
+        k = self._proj(params, x, "wk", "bk").reshape(s, h, hd)
+        v = self._proj(params, x, "wv", "bv").reshape(s, h, hd)
+        blk = jnp.take_along_axis(
+            block_tables, (positions // bs)[:, None], axis=1
+        )[:, 0]  # (S,) pool block holding each slot's write position
+        off = positions % bs
+        ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
+        cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
+        view_k = self._paged_view(ck, block_tables)  # (S, L, H, hd)
+        view_v = self._paged_view(cv, block_tables)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, view_k,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))  # (S, H, 1, L)
+        visible = jnp.arange(view_k.shape[1])[None] <= positions[:, None]
+        scores = jnp.where(
+            visible[:, None, None, :], scores, jnp.float32(-1e30)
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, view_v).reshape(s, 1,
+                                                                  h * hd)
+        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        if self.use_bias:
+            out = out + params["bo"].astype(out.dtype)
+        return out, {"k": ck, "v": cv}
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        """Prompt-chunk prefill for one sequence: x (1, C, D) covers
+        absolute positions [start, start+C). The whole chunk's K/V is
+        computed in ONE parallel pass (this is the prefill/decode split —
+        prompts never crawl through the one-token decode path), scattered
+        into the sequence's blocks, and attention runs against the full
+        cached prefix + chunk (so chunked prefill composes: chunk i
+        attends to chunks < i through the pool)."""
+        if not self.causal:
+            raise NotImplementedError(
+                "incremental decode requires causal attention "
+                "(MultiHeadAttention(causal=True)); bidirectional models "
+                "have no autoregressive decode"
+            )
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
+        c = x.shape[1]
+        h = self.num_heads
+        hd = params["wq"].shape[1] // h
+        bs = cache["k"].shape[1]
+        q = self._proj(params, x, "wq", "bq").reshape(1, c, h, hd)
+        k = self._proj(params, x, "wk", "bk").reshape(c, h, hd)
+        v = self._proj(params, x, "wv", "bv").reshape(c, h, hd)
+        abs_pos = start + jnp.arange(c)  # (C,)
+        blk = block_table[abs_pos // bs]  # (C,)
+        off = abs_pos % bs
+        ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
+        cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
+        view_k = self._paged_view(ck, block_table[None])[0]  # (L, H, hd)
+        view_v = self._paged_view(cv, block_table[None])[0]
+        scores = jnp.einsum(
+            "bqhd,khd->bhqk", q, view_k,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))  # (1, H, C, L)
+        visible = (
+            jnp.arange(view_k.shape[0])[None, :] <= abs_pos[:, None]
+        )  # (C, L): causal against the absolute position of each query
+        scores = jnp.where(
+            visible[None, None, :, :], scores, jnp.float32(-1e30)
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,khd->bqhd", attn, view_v).reshape(1, c,
+                                                                 h * hd)
+        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        if self.use_bias:
+            out = out + params["bo"].astype(out.dtype)
+        return out, {"k": ck, "v": cv}
+
     def apply(self, params, state, x, *, train=False, rng=None):
         dt = resolve_dtype(self.dtype)
         if dt is not None:
@@ -326,3 +452,17 @@ class PositionalEmbedding(Layer):
             params["table"], pos, 1, axis=0
         )  # (1, D)
         return x + row[None].astype(x.dtype), cache
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        # Per-SLOT positions: slot s reads table row positions[s] — the
+        # vectorized form of decode()'s single dynamic row.
+        rows = jnp.take(params["table"], positions, axis=0)  # (S, D)
+        return x + rows[:, None].astype(x.dtype), cache
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        c = x.shape[1]
+        rows = jax.lax.dynamic_slice_in_dim(
+            params["table"], start, c, axis=0
+        )  # (C, D)
+        return x + rows[None].astype(x.dtype), cache

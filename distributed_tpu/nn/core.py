@@ -84,6 +84,48 @@ class Layer:
         out, _ = self.apply(params, state, x, train=False)
         return out, cache
 
+    # -- paged decode (block KV cache, serving.Engine) ----------------------
+    # The paged counterparts of init_cache/decode: instead of one dense
+    # (B, max_len, ...) cache per sequence, attention layers write into a
+    # shared pool of fixed-size blocks, addressed through per-slot block
+    # tables — sequences of different lengths share one HBM pool
+    # (vLLM-style PagedAttention). Slots also carry PER-SLOT positions
+    # (a (S,) vector, not one scalar), which is what lets the serving
+    # engine decode sequences at different depths in one fixed-shape
+    # dispatch. Position-independent layers ride their existing decode()
+    # (which ignores pos); position-dependent layers (attention,
+    # positional embeddings) override.
+
+    def init_paged_cache(self, params: Params, num_blocks: int,
+                         block_size: int, dtype):
+        """Create this layer's share of the paged KV pool (empty for
+        layers that cache nothing)."""
+        return {}
+
+    def paged_decode(self, params: Params, state: State, cache, x, *,
+                     block_tables, positions):
+        """One decode step for a batch of SLOTS: x is (S, 1, ...),
+        ``block_tables`` (S, max_blocks) int32 pool indices,
+        ``positions`` (S,) int32 per-slot write/attend positions.
+        Returns (output, new_cache)."""
+        out, _ = self.decode(params, state, {}, x, pos=positions)
+        return out, cache
+
+    def paged_prefill(self, params: Params, state: State, cache, x, *,
+                      block_table, start):
+        """Prompt-chunk prefill for ONE sequence: x is (1, C, ...) covering
+        absolute positions [start, start+C); writes this chunk's KV into
+        the blocks named by ``block_table`` (max_blocks,) and returns
+        (output, new_cache). Default: position-independent layers apply
+        tokenwise and cache nothing."""
+        if not self.decode_safe:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support incremental "
+                "decode (generation)"
+            )
+        out, _ = self.apply(params, state, x, train=False)
+        return out, cache
+
     # -- shared helpers -----------------------------------------------------
     def sharding_hints(self) -> Dict[str, str]:
         """Tensor-parallel roles for this layer's params: param name ->
@@ -273,6 +315,47 @@ class Sequential(Layer):
                 new_cache[layer.name] = c
         return x, new_cache
 
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        caches = {}
+        for layer in self.layers:
+            c = layer.init_paged_cache(
+                params.get(layer.name, {}), num_blocks, block_size, dtype
+            )
+            if c:
+                caches[layer.name] = c
+        return caches
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        new_cache = dict(cache)
+        for layer in self.layers:
+            x, c = layer.paged_decode(
+                params.get(layer.name, {}),
+                state.get(layer.name, {}),
+                cache.get(layer.name, {}),
+                x,
+                block_tables=block_tables,
+                positions=positions,
+            )
+            if c:
+                new_cache[layer.name] = c
+        return x, new_cache
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        new_cache = dict(cache)
+        for layer in self.layers:
+            x, c = layer.paged_prefill(
+                params.get(layer.name, {}),
+                state.get(layer.name, {}),
+                cache.get(layer.name, {}),
+                x,
+                block_table=block_table,
+                start=start,
+            )
+            if c:
+                new_cache[layer.name] = c
+        return x, new_cache
+
     def summary_lines(self, input_shape: Shape):
         """Keras-style summary rows: (name, output_shape, param_count)."""
         from ..utils.tree import tree_size
@@ -411,6 +494,63 @@ class Residual(Layer):
             sc, cs = self.shortcut.decode(
                 params.get("shortcut", {}), state.get("shortcut", {}),
                 cache.get("shortcut", {}), x, pos=pos,
+            )
+            if cs:
+                new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self.activation(y + sc), new_cache
+
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        caches = {}
+        c = self.main.init_paged_cache(
+            params.get("main", {}), num_blocks, block_size, dtype
+        )
+        if c:
+            caches["main"] = c
+        if self.shortcut is not None:
+            c = self.shortcut.init_paged_cache(
+                params.get("shortcut", {}), num_blocks, block_size, dtype
+            )
+            if c:
+                caches["shortcut"] = c
+        return caches
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        y, cm = self.main.paged_decode(
+            params.get("main", {}), state.get("main", {}),
+            cache.get("main", {}), x,
+            block_tables=block_tables, positions=positions,
+        )
+        new_cache = dict(cache)
+        if cm:
+            new_cache["main"] = cm
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.paged_decode(
+                params.get("shortcut", {}), state.get("shortcut", {}),
+                cache.get("shortcut", {}), x,
+                block_tables=block_tables, positions=positions,
+            )
+            if cs:
+                new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self.activation(y + sc), new_cache
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        y, cm = self.main.paged_prefill(
+            params.get("main", {}), state.get("main", {}),
+            cache.get("main", {}), x, block_table=block_table, start=start,
+        )
+        new_cache = dict(cache)
+        if cm:
+            new_cache["main"] = cm
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.paged_prefill(
+                params.get("shortcut", {}), state.get("shortcut", {}),
+                cache.get("shortcut", {}), x,
+                block_table=block_table, start=start,
             )
             if cs:
                 new_cache["shortcut"] = cs

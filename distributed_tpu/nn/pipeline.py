@@ -265,6 +265,21 @@ class PipelinedBlocks(Layer):
             dtype,
         )
 
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        raise NotImplementedError(
+            "PipelinedBlocks does not support the paged (block) KV cache "
+            "yet — serve unstacked transformer_lm(pipeline=False) models, "
+            "or use Model.generate() (dense cache) for pipelined stacks"
+        )
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        raise NotImplementedError(
+            "PipelinedBlocks does not support the paged (block) KV cache "
+            "yet — serve unstacked transformer_lm(pipeline=False) models, "
+            "or use Model.generate() (dense cache) for pipelined stacks"
+        )
+
     def decode(self, params, state, cache, x, *, pos):
         from ..parallel.strategy import current_strategy
         from .scan import stacked_decode

@@ -87,6 +87,22 @@ class Remat(Layer):
         # No remat at decode: one-token steps have nothing worth dropping.
         return self.inner.decode(params, state, cache, x, pos=pos)
 
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        return self.inner.init_paged_cache(params, num_blocks, block_size,
+                                           dtype)
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        return self.inner.paged_decode(
+            params, state, cache, x,
+            block_tables=block_tables, positions=positions,
+        )
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        return self.inner.paged_prefill(
+            params, state, cache, x, block_table=block_table, start=start,
+        )
+
     # -- the actual behavior ------------------------------------------------
     def apply(self, params, state, x, *, train=False, rng=None):
         inner = self.inner

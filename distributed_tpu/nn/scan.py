@@ -239,3 +239,21 @@ class ScannedBlocks(Layer):
             self.block, params["blocks"], state.get("blocks", {}), cache, x,
             pos=pos,
         )
+
+    def paged_decode(self, params, state, cache, x, *, block_tables,
+                     positions):
+        # Inheriting the default (which routes through decode() with a
+        # VECTOR of per-slot positions) would die deep inside the scanned
+        # one-token step with an opaque shape error; fail loudly instead.
+        raise NotImplementedError(
+            "ScannedBlocks does not support the paged (block) KV cache yet "
+            "— serve unstacked transformer_lm(scan=False) models, or use "
+            "Model.generate() (dense cache) for scanned stacks"
+        )
+
+    def paged_prefill(self, params, state, cache, x, *, block_table, start):
+        raise NotImplementedError(
+            "ScannedBlocks does not support the paged (block) KV cache yet "
+            "— serve unstacked transformer_lm(scan=False) models, or use "
+            "Model.generate() (dense cache) for scanned stacks"
+        )

@@ -1,0 +1,31 @@
+"""Inference serving runtime: continuous batching over a paged KV cache.
+
+The training side of the framework ends at a trained, checkpointed model;
+this package is the serving side (ROADMAP open item 1): a request
+scheduler with iteration-level continuous batching, a paged/block KV
+cache so heterogeneous sequence lengths share one HBM pool, and a
+prefill/decode split so long prompts never crawl through the one-token
+decode loop.
+
+    engine = dtpu.serving.Engine(model, max_slots=8, block_size=16)
+    outs = engine.run([dtpu.serving.Request(prompt, max_new_tokens=64),
+                       ...])
+    engine.last_run_telemetry  # tokens/s, TTFT, kv_utilization, stalls
+
+Greedy decode (``temperature=0``) is token-identical per request to
+``model.generate()``; ``bench.py serve`` measures the throughput/latency
+win over the static-batch baseline (docs/SERVING.md).
+"""
+
+from .engine import Engine
+from .kv_cache import BlockAllocator, PagedKVCache
+from .scheduler import Request, Scheduler, Sequence
+
+__all__ = [
+    "Engine",
+    "Request",
+    "Scheduler",
+    "Sequence",
+    "BlockAllocator",
+    "PagedKVCache",
+]

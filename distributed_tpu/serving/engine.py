@@ -1,0 +1,375 @@
+"""Serving engine: continuous batching over a paged KV cache.
+
+``Engine(model, max_slots, block_size)`` turns a built token LM into a
+synchronous serving loop (``engine.run(requests)``) built from three
+pieces:
+
+- **Continuous batching** (``serving.scheduler``): requests are admitted
+  into decode SLOTS the moment one frees up — per decode step, not per
+  static batch — and finished sequences release their slot and KV blocks
+  immediately. Under heterogeneous prompt/response lengths this is the
+  throughput lever: the static ``generate()`` batch decodes until its
+  LAST member finishes, so early finishers burn slots as padding.
+- **Paged KV cache** (``serving.kv_cache`` +
+  ``nn.MultiHeadAttention.paged_decode``): one HBM pool of fixed-size
+  blocks shared by all slots, allocated on demand and freed on eviction,
+  with the cache dtype derived from the model's precision policy
+  (``Model.decode_dtype()``).
+- **Prefill/decode split**: a prompt is cached by its own PARALLEL
+  dispatch (optionally chunked via ``prefill_chunk``, which bounds how
+  much work ever sits between two decode steps) instead of crawling
+  through the one-token decode path; the decode loop for already-running
+  sequences proceeds between prefill chunks.
+
+The decode step is ONE jitted function over fixed shapes — ``(S,)``
+tokens, ``(S, nb)`` block tables, ``(S,)`` positions — so there is no
+per-step recompile however the batch composition churns; the scheduler
+expresses admissions/evictions purely by editing the host-side tables
+(dead or mid-prefill slots point at the trash block, à la the
+``steps_per_execution`` carry discipline of keeping the compiled program
+fixed and moving the bookkeeping to the host).
+
+Telemetry rides the existing ``StepTimer.attribute`` stall keys:
+``queue_wait`` (request admission waits), ``prefill`` / ``decode``
+(dispatch walls), plus ``kv_utilization`` (mean/peak block-pool
+occupancy) in ``engine.last_run_telemetry``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional, Sequence as SequenceT
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..training.model import Model, _cast_for_compute
+from ..utils.profiler import StepTimer
+from .kv_cache import PagedKVCache
+from .scheduler import Request, Scheduler
+
+
+def _prefill_dispatch(module, temperature, top_k, policy, dtype_hints,
+                      params, state, caches, tokens, block_table, start,
+                      last_idx, key):
+    """One prompt-chunk prefill for one sequence: tokens (1, Cb) covering
+    absolute positions [start, start+Cb) (right-padded past the real
+    chunk), KV scattered into the sequence's blocks, and the next token
+    sampled from the last REAL position's logits (meaningful only on the
+    final chunk; earlier chunks' samples are discarded host-side)."""
+    params = _cast_for_compute(policy, params, dtype_hints)
+    out, caches = module.paged_prefill(
+        params, state, caches, tokens, block_table=block_table, start=start
+    )
+    last = jax.lax.dynamic_slice_in_dim(out[0], last_idx, 1, axis=0)
+    tok = Model._sample_logits(last, key, temperature, top_k)  # (1,)
+    return tok[0], caches
+
+
+def _decode_dispatch(module, temperature, top_k, policy, dtype_hints,
+                     params, state, caches, tokens, block_tables, positions,
+                     key):
+    """One continuous-batching decode step over every slot: tokens (S,),
+    per-slot block tables and positions. Slots not currently decoding
+    carry all-trash tables, so their scatter writes are harmless and
+    their sampled tokens are ignored by the scheduler."""
+    params = _cast_for_compute(policy, params, dtype_hints)
+    logits, caches = module.paged_decode(
+        params, state, caches, tokens[:, None],
+        block_tables=block_tables, positions=positions,
+    )
+    sampled = Model._sample_logits(logits[:, 0], key, temperature, top_k)
+    return sampled, caches
+
+
+class Engine:
+    """Synchronous continuous-batching serving loop for a built token LM.
+
+    ``max_slots``: decode-batch width (the fixed S of the jitted step).
+    ``block_size``: KV-cache block granularity in positions.
+    ``max_len``: per-sequence context cap (prompt + generated); sizes the
+    block tables. ``num_blocks``: total pool blocks INCLUDING the
+    reserved trash block — default fully provisions
+    ``max_slots * ceil(max_len/block_size) + 1`` (no paging pressure);
+    set it lower to serve more slots than worst-case HBM would allow,
+    at the cost of possible preemptions. ``prefill_chunk``: cache prompts
+    in chunks of at most this many positions per dispatch (None = whole
+    prompt in one dispatch), bounding how long a long prompt can ever
+    delay the running batch's next decode step.
+
+    Sampling mirrors ``generate()``: ``temperature=0`` greedy (the
+    configuration whose outputs are token-identical to per-request
+    ``generate()``), ``top_k`` truncation otherwise; ``eos_id`` stops a
+    sequence early when sampled.
+    """
+
+    def __init__(self, model: Model, max_slots: int, block_size: int, *,
+                 max_len: int = 512, num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 eos_id: Optional[int] = None, seed: int = 0):
+        if not model.built:
+            raise RuntimeError("Model not built")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_len = int(max_len)
+        self.prefill_chunk = (
+            int(prefill_chunk) if prefill_chunk is not None else None
+        )
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self._base_key = jax.random.PRNGKey(seed)
+        self._dispatches = 0
+        # Positional capacity check up front (abstract: no allocation) —
+        # the paged path cannot raise at trace time the way init_cache
+        # does, so a too-short learned positional table must fail HERE,
+        # not produce silently clamped rows mid-serve.
+        jax.eval_shape(
+            lambda p: model.module.init_cache(p, 1, self.max_len,
+                                              jnp.float32),
+            model.params,
+        )
+        nb_per_seq = -(-self.max_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = self.max_slots * nb_per_seq + 1
+        self.kv = PagedKVCache(
+            model.module, model.params,
+            max_slots=self.max_slots, block_size=self.block_size,
+            max_blocks_per_seq=nb_per_seq, num_blocks=int(num_blocks),
+            dtype=model.decode_dtype(),
+        )
+        # Both dispatches jit once (decode shapes are fixed; prefill
+        # retraces only per distinct bucketed chunk length) under the
+        # model's strategy/precision scopes — same discipline as every
+        # Model step function.
+        self._prefill_fn = self.model._scoped(jax.jit(
+            functools.partial(
+                _prefill_dispatch, model.module, self.temperature,
+                self.top_k, model.precision, model._dtype_hints,
+            ),
+            donate_argnums=(2,),
+        ))
+        self._decode_fn = self.model._scoped(jax.jit(
+            functools.partial(
+                _decode_dispatch, model.module, self.temperature,
+                self.top_k, model.precision, model._dtype_hints,
+            ),
+            donate_argnums=(2,),
+        ))
+        self.last_run_telemetry = None
+
+    # ------------------------------------------------------------- helpers
+    def _next_key(self):
+        self._dispatches += 1
+        return jax.random.fold_in(self._base_key, self._dispatches)
+
+    def _bucket(self, c: int, start: int) -> int:
+        """Chunk lengths round up to a multiple of 64 (one compile per
+        bucket, exactly like generate()'s length bucketing), capped so
+        the padded chunk never runs past max_len — the positional
+        table's dynamic slice must not clamp, which would misalign the
+        REAL rows, and block indices must stay inside the table width."""
+        return min(max(64, -(-c // 64) * 64), self.max_len - start)
+
+    def _prefill_chunks(self, seq):
+        """(start, length) chunks covering seq's current context."""
+        total = seq.context_len
+        step = self.prefill_chunk or total
+        return [
+            (s, min(step, total - s)) for s in range(0, total, step)
+        ]
+
+    # ---------------------------------------------------------------- run
+    def run(self, requests: SequenceT) -> List[np.ndarray]:
+        """Serve ``requests`` (a sequence of ``serving.Request``, or
+        (prompt, max_new_tokens) pairs) to completion; returns each
+        request's prompt+generated tokens in submission order —
+        row-compatible with ``generate()`` per request. Telemetry for the
+        run lands in ``engine.last_run_telemetry``."""
+        reqs = [
+            r if isinstance(r, Request) else Request(r[0], r[1])
+            for r in requests
+        ]
+        for r in reqs:
+            need = r.prompt.size + r.max_new_tokens
+            if need > self.max_len:
+                raise ValueError(
+                    f"request {r.request_id}: prompt {r.prompt.size} + "
+                    f"max_new_tokens {r.max_new_tokens} exceeds engine "
+                    f"max_len {self.max_len}"
+                )
+        timer = StepTimer(warmup=0)
+        sched = Scheduler(self.max_slots)
+        t0 = time.perf_counter()
+        for r in reqs:
+            sched.submit(r, now=0.0)
+        params, state = self.model.params, self.model.state
+        results = {}
+        ttft = {}
+        util_samples = []
+        decode_steps = 0
+        prefill_dispatches = 0
+        preemptions = 0
+        # (seq, chunk list, next chunk index): at most ONE chunk runs per
+        # loop iteration, so running sequences keep decoding between a
+        # long prompt's chunks instead of stalling behind all of them.
+        prefill_jobs = []
+
+        def elapsed():
+            return time.perf_counter() - t0
+
+        def finish(seq):
+            sched.finish(seq, self.kv)
+            results[seq.request.request_id] = seq.output()
+
+        while not (sched.idle and not prefill_jobs):
+            # -- admit: fill every free slot the pool can back ------------
+            while True:
+                seq = sched.next_admittable(self.kv)
+                if seq is None:
+                    break
+                timer.attribute("queue_wait", elapsed() - seq.enqueued_at)
+                prefill_jobs.append([seq, self._prefill_chunks(seq), 0])
+            if not sched.running:
+                # Nothing running and nothing admittable: the queue head's
+                # context cannot fit even an EMPTY pool.
+                head = sched.waiting[0]
+                raise RuntimeError(
+                    f"request {head.request.request_id}: context of "
+                    f"{head.context_len} tokens needs "
+                    f"{self.kv.blocks_for(head.context_len)} blocks but "
+                    f"the pool only has {self.kv.allocator.num_allocatable}"
+                    " allocatable — raise num_blocks or lower max_len"
+                )
+            # -- one prefill chunk, if any are pending --------------------
+            if prefill_jobs:
+                job = prefill_jobs[0]
+                seq, chunks, idx = job
+                if seq.slot is None:  # preempted mid-prefill: job is moot
+                    prefill_jobs.pop(0)
+                    continue
+                start, c = chunks[idx]
+                cb = self._bucket(c, start)
+                buf = np.zeros((1, cb), np.int32)
+                buf[0, :c] = seq.tokens[start:start + c]
+                tp = time.perf_counter()
+                tok, self.kv.caches = self._prefill_fn(
+                    params, state, self.kv.caches, buf,
+                    self.kv.block_tables[seq.slot],
+                    np.int32(start),
+                    np.int32(seq.context_len - 1 - start
+                             if idx == len(chunks) - 1 else c - 1),
+                    self._next_key(),
+                )
+                prefill_dispatches += 1
+                job[2] = idx + 1
+                if job[2] == len(chunks):
+                    # Final chunk: the sampled continuation is real.
+                    first = int(jax.device_get(tok))
+                    timer.attribute("prefill", time.perf_counter() - tp)
+                    prefill_jobs.pop(0)
+                    self.kv.positions[seq.slot] = seq.context_len
+                    seq.tokens.append(first)
+                    seq.num_generated += 1
+                    if seq.num_generated == 1:
+                        ttft[seq.request.request_id] = elapsed()
+                    if seq.finished or first == self.eos_id:
+                        finish(seq)
+                else:
+                    timer.attribute("prefill", time.perf_counter() - tp)
+            # -- decode: every running slot whose prefill is done ---------
+            mid_prefill = {
+                id(j[0]) for j in prefill_jobs if j[0].slot is not None
+            }
+            ready = [
+                s for s in sched.running if id(s) not in mid_prefill
+            ]
+            # Grow each ready slot's table to cover its next write
+            # position; under pool pressure evict the youngest runner
+            # back to the queue (its generated tokens ride along and are
+            # re-prefilled on re-admission).
+            for seq in ready:
+                if seq.slot is None:
+                    continue  # evicted by an older peer this pass
+                while not self.kv.reserve(seq.slot, seq.context_len):
+                    victim = sched.preempt_youngest(self.kv, protect=seq)
+                    if victim is None:
+                        raise RuntimeError(
+                            f"request {seq.request.request_id}: cannot "
+                            f"back {seq.context_len} positions with "
+                            f"{self.kv.num_blocks - 1} pool blocks even "
+                            "alone — raise num_blocks"
+                        )
+                    preemptions += 1
+                    victim.enqueued_at = elapsed()
+                    # Any in-flight prefill job of the victim is void: on
+                    # re-admission it gets a fresh job starting at chunk 0.
+                    prefill_jobs[:] = [
+                        j for j in prefill_jobs if j[0] is not victim
+                    ]
+            ready = [s for s in ready if s.slot is not None]
+            if not ready:
+                continue
+            tokens = np.zeros((self.max_slots,), np.int32)
+            ready_mask = np.zeros((self.max_slots,), bool)
+            for seq in ready:
+                tokens[seq.slot] = seq.last_token
+                ready_mask[seq.slot] = True
+            # Slots that are free or mid-prefill get all-trash tables for
+            # this dispatch: their scatter writes must not touch blocks a
+            # live (possibly half-prefilled) sequence owns.
+            tables = np.where(
+                ready_mask[:, None], self.kv.block_tables, np.int32(0)
+            )
+            positions = np.where(ready_mask, self.kv.positions, 0).astype(
+                np.int32
+            )
+            td = time.perf_counter()
+            sampled, self.kv.caches = self._decode_fn(
+                params, state, self.kv.caches, tokens, tables, positions,
+                self._next_key(),
+            )
+            sampled = np.asarray(jax.device_get(sampled))
+            timer.attribute("decode", time.perf_counter() - td)
+            decode_steps += 1
+            util_samples.append(self.kv.utilization())
+            for seq in ready:
+                tok = int(sampled[seq.slot])
+                self.kv.positions[seq.slot] = seq.context_len
+                seq.tokens.append(tok)
+                seq.num_generated += 1
+                if seq.finished or tok == self.eos_id:
+                    finish(seq)
+        report = timer.stall_report()
+        report["kv_utilization"] = {
+            "mean": round(float(np.mean(util_samples)), 4)
+            if util_samples else 0.0,
+            "peak": round(float(np.max(util_samples)), 4)
+            if util_samples else 0.0,
+        }
+        report["generated_tokens"] = int(
+            sum(len(results[r.request_id]) - r.prompt.size for r in reqs)
+        )
+        report["tokens_per_sec"] = round(
+            report["generated_tokens"] / report["total_seconds"], 3
+        )
+        report["time_to_first_token"] = {
+            "mean": round(float(np.mean(list(ttft.values()))), 4),
+            "max": round(float(np.max(list(ttft.values()))), 4),
+        }
+        report["decode_steps"] = decode_steps
+        report["prefill_dispatches"] = prefill_dispatches
+        report["preemptions"] = preemptions
+        self.last_run_telemetry = report
+        return [results[r.request_id] for r in reqs]
+
+
+__all__ = ["Engine"]

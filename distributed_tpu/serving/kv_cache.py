@@ -1,0 +1,149 @@
+"""Paged (block) KV cache: one HBM pool, per-sequence block tables.
+
+The dense decode cache ``Model.generate()`` uses reserves
+``batch x max_len`` rows per attention layer up front — every sequence
+pays for the longest it MIGHT get. Under a serving workload with
+heterogeneous prompt/response lengths that reservation is mostly air.
+Here the cache is a pool of fixed-size blocks (``block_size`` positions
+each) shared by every running sequence: a sequence owns just the blocks
+covering the positions it has actually filled (allocated on demand as it
+grows, freed the moment it finishes or is preempted), and a per-slot
+block table maps logical positions to pool blocks — vLLM's
+PagedAttention layout. The device-side read/write path lives in
+``nn.MultiHeadAttention.{paged_decode,paged_prefill}``; this module owns
+the host-side bookkeeping.
+
+Block 0 is reserved as the TRASH block: the engine points every
+unallocated block-table entry (and every inactive slot's whole table) at
+it, so the fixed-shape decode dispatch can scatter unconditionally —
+writes from dead slots land in block 0 and no live sequence ever reads
+it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class BlockAllocator:
+    """Free-list over the pool's allocatable blocks (1..num_blocks-1;
+    block 0 is the trash block). Allocation is all-or-nothing and LIFO
+    (recently freed blocks are reused first — friendliest to any
+    allocator-backed backend), frees are idempotent-checked."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved as the "
+                f"trash block); got {num_blocks}"
+            )
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._allocated = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocatable(self) -> int:
+        return self.num_blocks - 1
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None when the pool cannot serve all of them
+        (all-or-nothing: a partial grant would deadlock admission)."""
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+    def utilization(self) -> float:
+        """Fraction of allocatable pool blocks currently owned."""
+        return len(self._allocated) / max(self.num_allocatable, 1)
+
+
+class PagedKVCache:
+    """Device block pools + host block tables for ``max_slots`` decode
+    slots.
+
+    ``caches`` holds the module's per-layer pools
+    (``module.init_paged_cache``: K/V of shape
+    ``(num_blocks, block_size, H, hd)`` per attention layer, dtype from
+    the model's precision policy via ``Model.decode_dtype()``).
+    ``block_tables`` is the host-side (max_slots, max_blocks_per_seq)
+    int32 map the engine ships with every decode dispatch; unassigned
+    entries stay 0 (the trash block)."""
+
+    def __init__(self, module, params, *, max_slots: int, block_size: int,
+                 max_blocks_per_seq: int, num_blocks: int, dtype):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.max_slots = int(max_slots)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.caches = module.init_paged_cache(
+            params, self.num_blocks, self.block_size, dtype
+        )
+        self.allocator = BlockAllocator(self.num_blocks)
+        self.block_tables = np.zeros(
+            (self.max_slots, self.max_blocks_per_seq), np.int32
+        )
+        self.positions = np.zeros((self.max_slots,), np.int32)
+        self._slot_blocks: List[List[int]] = [[] for _ in range(max_slots)]
+
+    def blocks_for(self, tokens: int) -> int:
+        """Blocks needed to hold ``tokens`` positions."""
+        return -(-int(tokens) // self.block_size)
+
+    def reserve(self, slot: int, upto_len: int) -> bool:
+        """Grow ``slot``'s table so positions < ``upto_len`` are backed by
+        real blocks. All-or-nothing; False when the pool is exhausted (the
+        scheduler then preempts someone)."""
+        need = self.blocks_for(upto_len)
+        if need > self.max_blocks_per_seq:
+            raise ValueError(
+                f"sequence length {upto_len} needs {need} blocks of "
+                f"{self.block_size}, above the per-sequence cap "
+                f"{self.max_blocks_per_seq} (engine max_len)"
+            )
+        have = len(self._slot_blocks[slot])
+        if need <= have:
+            return True
+        grant = self.allocator.allocate(need - have)
+        if grant is None:
+            return False
+        for i, b in enumerate(grant):
+            self.block_tables[slot, have + i] = b
+        self._slot_blocks[slot].extend(grant)
+        return True
+
+    def release(self, slot: int) -> None:
+        """Free every block ``slot`` owns and point its table back at the
+        trash block (so an inactive slot's scatter writes stay harmless)."""
+        blocks = self._slot_blocks[slot]
+        if blocks:
+            self.allocator.free(blocks)
+        self._slot_blocks[slot] = []
+        self.block_tables[slot, :] = 0
+        self.positions[slot] = 0
+
+    def utilization(self) -> float:
+        return self.allocator.utilization()
+
+    @property
+    def live_blocks(self) -> int:
+        return sum(len(b) for b in self._slot_blocks)
+
+
+__all__ = ["BlockAllocator", "PagedKVCache"]

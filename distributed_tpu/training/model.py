@@ -1494,6 +1494,29 @@ class Model:
         )
 
     # --------------------------------------------------------------- generate
+    def decode_dtype(self):
+        """KV-cache / activation dtype for autoregressive decode, shared by
+        ``generate()`` and ``serving.Engine``. Under a precision policy it
+        IS the policy's compute dtype (no abstract trace needed — and a
+        bare trace would miss the scope-resolved layer dtypes); without
+        one it comes from an abstract trace of the forward pass (the
+        logits dtype equals the activation dtype for these models).
+        Memoized per build/compile/load."""
+        if not self.built:
+            raise RuntimeError("Model not built")
+        if self._decode_dtype is None:
+            if self.precision is not None:
+                self._decode_dtype = self.precision.compute_dtype
+            else:
+                module, params, state = self.module, self.params, self.state
+                self._decode_dtype = jax.eval_shape(
+                    lambda p: module.apply(
+                        p, state, jnp.zeros((1, 1), jnp.int32)
+                    )[0],
+                    params,
+                ).dtype
+        return self._decode_dtype
+
     @staticmethod
     def _sample_logits(logits, key, temperature, top_k):
         logits = logits.astype(jnp.float32)
@@ -1553,31 +1576,15 @@ class Model:
         # itself flows in as a dynamic argument to the teacher-forcing mask.
         bucket = max(64, -(-max_len // 64) * 64)
         module, params, state = self.module, self.params, self.state
-        if self._decode_dtype is None:
-            if self.precision is not None:
-                # Under a policy the KV-cache/activation dtype IS the
-                # policy's compute dtype — no abstract trace needed (and a
-                # bare trace would miss the scope-resolved layer dtypes).
-                self._decode_dtype = self.precision.compute_dtype
-            else:
-                # Activation dtype for the KV cache, from an abstract
-                # trace of the forward pass (the logits dtype equals the
-                # activation dtype for these models). Memoized: per built
-                # model, not per generate() call.
-                self._decode_dtype = jax.eval_shape(
-                    lambda p: module.apply(
-                        p, state, jnp.zeros((1, 1), jnp.int32)
-                    )[0],
-                    params,
-                ).dtype
+        decode_dtype = self.decode_dtype()
         try:
-            cache = module.init_cache(params, b, bucket, self._decode_dtype)
+            cache = module.init_cache(params, b, bucket, decode_dtype)
         except ValueError:
             # Bucketed length exceeds the model's capacity (e.g. a learned
             # positional table shorter than the bucket): fall back to the
             # exact requested length.
             bucket = max_len
-            cache = module.init_cache(params, b, bucket, self._decode_dtype)
+            cache = module.init_cache(params, b, bucket, decode_dtype)
         padded = np.zeros((b, bucket), np.int32)
         padded[:, :t_p] = prompt
 

@@ -1,7 +1,8 @@
-from . import events, logging, profiler, sync_check, tree
+from . import compile_cache, events, logging, profiler, sync_check, tree
 from .sync_check import assert_replicas_identical, replica_drift
 
 __all__ = [
+    "compile_cache",
     "events",
     "logging",
     "profiler",

@@ -1,0 +1,177 @@
+"""Persistent XLA compilation cache (jax_compilation_cache_dir) setup.
+
+Compilation dominates two wall-clock budgets this repo cares about:
+
+- **CI**: the tier-1 suite on the 1-core box measured 869s against the
+  870s kill at PR 5, and most of that is jit compiles repeated identically
+  run after run.
+- **Production restarts**: the resilience supervisor's
+  restart-to-first-step latency (``bench.py resilience``) is process spawn
+  + imports + checkpoint restore + *jit recompile* — the recompile is the
+  dominant term for real models, and a warm persistent cache removes it
+  (measured 1.8x faster restart-to-first-step, BENCH_compile_cache.json).
+
+:func:`enable` points JAX's persistent compilation cache at a directory
+keyed per box + JAX version + Python version, so serialized executables
+are never shared across incompatible toolchains (a cache dir on shared
+storage would otherwise mix them), and makes cache-entry writes atomic
+(kill-safe). Callers: ``tests/conftest.py`` (every pytest process) and
+any production launcher that wants cheap restarts. Subprocess workers
+are deliberately NOT pointed at the shared cache by env var — see
+:func:`enable`, which also documents why the cache is OFF by default on
+the XLA:CPU backend (this jaxlib's CPU executable serializer corrupts
+the heap for some programs — tier-1's budget rescue on the CPU box
+therefore comes from the whale triage, and the cache pays off on
+accelerator backends).
+
+``DTPU_COMPILE_CACHE``: ``0`` never, ``1`` always (including CPU —
+measure at your own risk), unset = accelerator backends only. Relocate
+with ``DTPU_COMPILE_CACHE_DIR=/path`` (or JAX's own
+``JAX_COMPILATION_CACHE_DIR``, which wins because it reaches the config
+before we do).
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+from typing import Optional
+
+
+def default_cache_dir() -> str:
+    """Per-box, per-toolchain cache directory: serialized XLA executables
+    are only valid for the exact jax/jaxlib build (and, conservatively,
+    the box) that wrote them, so the key includes hostname + jax version +
+    python minor version."""
+    import jax
+
+    tag = (
+        f"{platform.node() or 'localhost'}"
+        f"-jax{jax.__version__}"
+        f"-py{sys.version_info.major}.{sys.version_info.minor}"
+    )
+    base = os.environ.get(
+        "DTPU_COMPILE_CACHE_DIR",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "dtpu", "jax-compile-cache"
+        ),
+    )
+    return os.path.join(base, tag)
+
+
+def _patch_atomic_cache_writes() -> bool:
+    """Make jax's disk-cache writes ATOMIC (temp file + os.replace).
+
+    ``LRUCache.put`` writes entries with a bare ``write_bytes`` and never
+    rewrites an existing path — so a process killed mid-write (the tier-1
+    runner's 870s ``timeout -k 10``, a preempted worker, the resilience
+    suite's kill injection) leaves a PERMANENTLY truncated entry, and
+    deserializing it crashes every later reader with SIGSEGV/SIGABRT (a
+    C++ executable-deserialize failure, observed while building
+    ``bench.py compile_cache``). A shared per-box cache must survive
+    kills, so the write is replaced with write-to-temp + rename, both for
+    the entry and its atime stamp. Best-effort: returns False (and the
+    cache still works, minus kill-safety) if jax's internals moved."""
+    try:
+        import tempfile
+        import time
+
+        from jax._src import lru_cache as _lru
+
+        if getattr(_lru.LRUCache, "_dtpu_atomic_put", False):
+            return True
+        cache_sfx = _lru._CACHE_SUFFIX
+        atime_sfx = _lru._ATIME_SUFFIX
+
+        def _write_atomic(path, data):
+            fd, tmp = tempfile.mkstemp(
+                dir=str(path.parent), prefix=f".{path.name}.tmp"
+            )
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+
+        def put(self, key, val):
+            # Same contract as LRUCache.put (first write wins, eviction
+            # under the lock), with atomic file creation.
+            if not key:
+                raise ValueError("key cannot be empty")
+            if self.eviction_enabled and len(val) > self.max_size:
+                return
+            cache_path = self.path / f"{key}{cache_sfx}"
+            atime_path = self.path / f"{key}{atime_sfx}"
+            if self.eviction_enabled:
+                self.lock.acquire(timeout=self.lock_timeout_secs)
+            try:
+                if cache_path.exists():
+                    return
+                self._evict_if_needed(additional_size=len(val))
+                _write_atomic(cache_path, val)
+                _write_atomic(
+                    atime_path, time.time_ns().to_bytes(8, "little")
+                )
+            finally:
+                if self.eviction_enabled:
+                    self.lock.release()
+
+        _lru.LRUCache.put = put
+        _lru.LRUCache._dtpu_atomic_put = True
+        return True
+    except Exception:
+        return False
+
+
+def enable(cache_dir: Optional[str] = None,
+           force: bool = False) -> Optional[str]:
+    """Turn on the persistent compilation cache; returns the directory in
+    use (None when disabled or skipped). Safe to call any time before (or
+    after) the first compile — JAX consults the config per compilation. A
+    dir already set (env ``JAX_COMPILATION_CACHE_DIR`` or a prior call)
+    is respected.
+
+    ``DTPU_COMPILE_CACHE`` modes: ``0`` never, ``1`` always, unset/auto
+    = **accelerator backends only**. The CPU skip is a measured
+    necessity, not caution: on this jaxlib (0.4.37), serializing certain
+    XLA:CPU executables (observed with the ``jax.checkpoint``-rematerialized
+    chunked-head scan, under donation) corrupts the process heap —
+    `pytest tests/test_chunked_head.py` with the STOCK jax cache (no
+    wrapper code at all) aborts/segfaults 5/5 runs and passes 3/3 with
+    the cache off. On TPU/GPU the persistent cache is the battle-tested
+    standard path, and the restart-latency win is real (`bench.py
+    compile_cache`, BENCH_compile_cache.json).
+
+    NOTE this enables the cache for THIS process only (jax config, not
+    env), on purpose: a subprocess that inherited only the env var would
+    write entries WITHOUT the atomic-write patch below, and a kill
+    mid-write would poison the shared cache for every later run."""
+    mode = os.environ.get("DTPU_COMPILE_CACHE", "auto")
+    if mode == "0":
+        return None
+    import jax
+
+    if mode != "1" and not force and jax.default_backend() == "cpu":
+        return None
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        cache_dir = current
+    else:
+        cache_dir = cache_dir or default_cache_dir()
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Thresholds stay at the JAX defaults (min_compile_time 1s): caching
+    # every tiny eager-op executable multiplies the serialize traffic for
+    # no meaningful warm-start win — the >=1s compiles are where the
+    # wall time lives.
+    _patch_atomic_cache_writes()
+    os.makedirs(cache_dir, exist_ok=True)
+    return cache_dir
+
+
+__all__ = ["enable", "default_cache_dir"]

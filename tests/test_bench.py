@@ -55,9 +55,14 @@ def test_bench_convergence_prefers_real_digits():
     assert out["accuracy"] > 0.3  # real data, 2 epochs: well above chance
 
 
+@pytest.mark.slow
 def test_bench_resnet50_smoke():
     # Tiny resolution keeps CPU conv time sane; depth stays 50 so the real
     # block structure (bottleneck, projection shortcuts) compiles.
+    # @slow: compiling the full 50-layer block structure costs ~43s on the
+    # 1-core tier-1 box (the suite's single biggest test) — the ResNet
+    # MODEL is still covered in tier-1 by tests/test_resnet.py; this
+    # exercises only the bench harness around it.
     out = bench.bench_resnet50(
         global_batch=8, image_size=32, warmup=1, measure=2, num_classes=10
     )
@@ -102,6 +107,26 @@ def test_bench_precision_smoke():
     # the comms win: FSDP's gathered-param (and grad) bytes halve
     assert out["gathered_param_bytes_ratio_f32_vs_mixed"] == 2.0
     assert out["grad_reduce_bytes_ratio_f32_vs_mixed"] == 2.0
+
+
+def test_bench_serve_smoke():
+    """The serving mode: tiny shapes, single repeat — the real
+    continuous-batching-vs-static comparison runs via `python bench.py
+    serve` (BENCH_serve.json). Exercises the full path: Engine
+    construction, heterogeneous workload, the static generate() baseline,
+    and the artifact schema. No speedup assertion: CPU smoke timings at
+    these shapes measure dispatch overhead, not serving."""
+    out = bench.bench_serve(
+        num_requests=4, max_slots=2, block_size=8, vocab=32, num_layers=1,
+        d_model=16, num_heads=2, max_len=64, prompt_range=(2, 6),
+        new_range=(2, 6), repeats=1,
+    )
+    assert out["unit"] == "tokens/s" and out["value"] > 0
+    assert out["static_batch_tokens_per_sec"] > 0
+    assert out["speedup_vs_static"] > 0
+    assert out["ttft_mean_s"] > 0 and out["static_ttft_mean_s"] > 0
+    assert 0.0 <= out["kv_utilization"]["peak"] <= 1.0
+    assert out["workload"]["useful_tokens"] > 0
 
 
 def test_bench_output_contract(monkeypatch, capsys):
