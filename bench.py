@@ -1133,6 +1133,262 @@ def bench_compile_cache(train_steps=8, kill_step=3, save_freq=2,
     }
 
 
+# --------------------------------------------------------------- elastic ----
+_ELASTIC_WORKER = """
+import os, sys, time
+sys.path.insert(0, os.environ["BENCH_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import distributed_tpu as dtpu
+from distributed_tpu.data.pipeline import Pipeline
+from distributed_tpu.launch import report_result
+from distributed_tpu.resilience import FaultInjector
+from distributed_tpu.training.callbacks import LambdaCallback, ModelCheckpoint
+from distributed_tpu.utils import events
+
+spec = dtpu.cluster.initialize()
+world = spec.num_processes
+attempt = int(os.environ.get("DTPU_ATTEMPT", "1"))
+GB = int(os.environ["BENCH_GB"])
+STEPS = int(os.environ["BENCH_STEPS"])
+record_loss = os.environ.get("BENCH_RECORD_LOSS") == "1"
+
+x, y = dtpu.data.synthetic_images(256, (8, 8), 10, 0)
+strategy = dtpu.DataParallel() if world > 1 else dtpu.SingleDevice()
+with strategy.scope():
+    m = dtpu.Model(dtpu.nn.Sequential([
+        dtpu.nn.Flatten(),
+        dtpu.nn.Dense(32, activation="relu"),
+        dtpu.nn.Dense(10),
+    ]))
+    m.compile(optimizer=dtpu.optim.SGD(0.05),
+              loss="sparse_categorical_crossentropy")
+m.build((8, 8))
+
+seen_first = []
+def on_step(model, step, logs):
+    if not seen_first:
+        seen_first.append(step)
+        events.emit("first_step", attempt=attempt, step=int(step),
+                    world=world)
+    if spec.index == 0:
+        events.emit("step_mark", attempt=attempt, world=world,
+                    step=int(step),
+                    loss=(float(logs["loss"]) if record_loss else None))
+
+cbs = [ModelCheckpoint(os.environ["BENCH_CKPT"], sharded=True,
+                       save_freq=int(os.environ.get("BENCH_SAVE_FREQ", "2")),
+                       restore=True),
+       LambdaCallback(on_batch_end=on_step)]
+
+# Capacity-regain trigger (grow direction): rank 0 flips the supervisor's
+# capacity-probe file just before the injected transient kill, so the
+# restart boundary sees the regained capacity.
+cap_file = os.environ.get("BENCH_CAP_FLIP_FILE")
+if cap_file and spec.index == 0:
+    flip_at = int(os.environ.get("BENCH_CAP_FLIP_AT", "3"))
+    def flip(model, step, logs):
+        if step >= flip_at:
+            with open(cap_file, "w") as f:
+                f.write(os.environ.get("BENCH_CAP_FLIP_TO", "4"))
+    cbs.append(LambdaCallback(on_batch_end=flip))
+
+# Permanent-loss model: the fault stays armed while the world is ABOVE the
+# surviving capacity (BENCH_FAULT_ABOVE) — every relaunch at the doomed
+# size dies again, which is exactly what per-rank attribution must see.
+# With a once-marker (grow direction) the fault is the usual transient one.
+fault = FaultInjector.from_env()
+if fault is not None and world > int(os.environ.get("BENCH_FAULT_ABOVE", "0")):
+    cbs.append(fault)
+
+with Pipeline(x, y, GB, seed=0, use_native=False,
+              shard=(spec.index, world)) as p:
+    m.fit(p, epochs=1, steps_per_epoch=STEPS, verbose=0, callbacks=cbs)
+
+report_result({"world": world, "final_step": int(m.step)})
+"""
+
+
+def _elastic_gang(tmp, *, world, min_workers, max_workers=None,
+                  global_batch=64, steps=10, fault=None, fault_above=0,
+                  probe_file=None, cap_flip_to=None, cap_flip_at=3,
+                  record_loss=False, failure_threshold=2, max_restarts=3,
+                  save_freq=2, timeout=600.0, grace=5.0):
+    """One supervised elastic-gang scenario (shared by ``bench.py elastic``
+    and tests/test_elastic.py): N workers train the same tiny LM-free dense
+    model from per-host-sharded pipelines with sharded checkpoints; faults
+    and the capacity probe come from the arguments. Returns the
+    SupervisedResult plus the run's event records."""
+    import os
+    from pathlib import Path
+
+    from distributed_tpu.resilience import (
+        ElasticPolicy, RestartPolicy, Supervisor,
+    )
+    from distributed_tpu.utils.events import EventLog
+
+    tmp = Path(tmp)
+    tmp.mkdir(parents=True, exist_ok=True)
+    worker = tmp / "worker.py"
+    worker.write_text(_ELASTIC_WORKER)
+    log = EventLog(tmp / "events.jsonl")
+    env_extra = {
+        "BENCH_REPO": os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_CKPT": str(tmp / "ckpt"),
+        "BENCH_GB": str(global_batch),
+        "BENCH_STEPS": str(steps),
+        "BENCH_SAVE_FREQ": str(save_freq),
+        "BENCH_FAULT_ABOVE": str(fault_above),
+    }
+    if record_loss:
+        env_extra["BENCH_RECORD_LOSS"] = "1"
+    if fault:
+        env_extra["DTPU_FAULT"] = fault
+        if fault_above == 0:
+            env_extra["DTPU_FAULT_MARKER"] = str(tmp / "fault_once")
+    probe = None
+    if probe_file is not None:
+        probe_path = Path(probe_file)
+
+        def probe():
+            return int(probe_path.read_text().strip())
+
+        if cap_flip_to is not None:
+            env_extra["BENCH_CAP_FLIP_FILE"] = str(probe_path)
+            env_extra["BENCH_CAP_FLIP_AT"] = str(cap_flip_at)
+            env_extra["BENCH_CAP_FLIP_TO"] = str(cap_flip_to)
+    sup = Supervisor(
+        [sys.executable, str(worker)], world,
+        policy=RestartPolicy(max_restarts=max_restarts, backoff=0.01,
+                             backoff_max=0.01),
+        elastic=ElasticPolicy(
+            min_workers=min_workers,
+            max_workers=max_workers if max_workers is not None else world,
+            failure_threshold=failure_threshold,
+            probe=probe,
+            divisor_of=global_batch,
+        ),
+        checkpoint_dir=tmp / "ckpt",
+        event_log=log,
+        env_extra=env_extra,
+    )
+    result = sup.run(timeout=timeout, grace=grace)
+    return result, log.read()
+
+
+def _elastic_rate(events, attempt):
+    """steps/s within one attempt from its rank-0 step_mark timestamps,
+    excluding the attempt's first step (jit compile)."""
+    marks = sorted(
+        (e["step"], e["ts"]) for e in events
+        if e["event"] == "step_mark" and e["attempt"] == attempt
+    )
+    marks = marks[1:]
+    if len(marks) < 2:
+        return None
+    (s0, t0), (s1, t1) = marks[0], marks[-1]
+    return round((s1 - s0) / max(t1 - t0, 1e-9), 3)
+
+
+def _resize_latency(events, end_attempt, first_attempt):
+    """Wall-clock from the doomed attempt's end to the re-formed gang's
+    first completed optimizer step — resize-to-first-step, the elastic
+    sibling of ``bench.py resilience``'s restart-to-first-step."""
+    end = next((e for e in events if e["event"] == "attempt_end"
+                and e["attempt"] == end_attempt), None)
+    first = next((e for e in events if e["event"] == "first_step"
+                  and e["attempt"] == first_attempt), None)
+    if end is None or first is None:
+        return None
+    return round(first["ts"] - end["ts"], 3)
+
+
+def bench_elastic(steps=10, global_batch=64):
+    """Elastic-gang cost on the production resize paths (ROADMAP item 2,
+    docs/RESILIENCE.md "Elastic gangs"): a 4->2->4 world-size cycle run as
+    two supervised scenarios on XLA:CPU gangs (1 device per process).
+
+    - **shrink**: a 4-worker gang with a PERMANENT rank-1 loss (the fault
+      re-fires on every relaunch above capacity). Attribution takes
+      ``failure_threshold=2`` attempts, then the supervisor re-forms at
+      N'=2 (64 % 3 != 0, so ``divisor_of`` snaps 3 -> 2) and the run
+      completes — restoring the 4-process sharded checkpoint into the
+      2-process gang through the block index.
+    - **grow**: a 2-worker gang under a capacity probe; the worker flips
+      the probe file to 4 right before a transient kill, so the restart
+      boundary grows the gang back to 4.
+
+    Reported: resize-to-first-step latency for both directions (process
+    spawn + jax init + N'-gang formation + sharded N->N' restore + jit
+    recompile) and steps/s before/after each resize. Artifact:
+    BENCH_elastic.json."""
+    import tempfile
+    from pathlib import Path
+
+    tmp = Path(tempfile.mkdtemp(prefix="dtpu_bench_elastic_"))
+
+    shrink_res, shrink_ev = _elastic_gang(
+        tmp / "shrink", world=4, min_workers=2, global_batch=global_batch,
+        steps=steps, fault="kill:at_step=4,rank=1", fault_above=2,
+        failure_threshold=2, max_restarts=3,
+    )
+    shrink_final = shrink_res.attempts
+    shrink = {
+        "from_world": 4,
+        "to_world": shrink_res.world_size,
+        "ok": shrink_res.ok,
+        "attempts": shrink_res.attempts,
+        "restarts_used": shrink_res.restarts_used,
+        "resizes": shrink_res.resizes,
+        "resize_to_first_step_seconds": _resize_latency(
+            shrink_ev, shrink_final - 1, shrink_final),
+        "steps_per_s_before": _elastic_rate(shrink_ev, 1),
+        "steps_per_s_after": _elastic_rate(shrink_ev, shrink_final),
+    }
+
+    cap = tmp / "capacity"
+    cap.write_text("2")
+    grow_res, grow_ev = _elastic_gang(
+        tmp / "grow", world=2, min_workers=2, max_workers=4,
+        global_batch=global_batch, steps=steps,
+        fault="kill:at_step=3,rank=0", fault_above=0,
+        probe_file=cap, cap_flip_to=4, cap_flip_at=3, max_restarts=3,
+    )
+    grow_final = grow_res.attempts
+    grow = {
+        "from_world": 2,
+        "to_world": grow_res.world_size,
+        "ok": grow_res.ok,
+        "attempts": grow_res.attempts,
+        "restarts_used": grow_res.restarts_used,
+        "resizes": grow_res.resizes,
+        "resize_to_first_step_seconds": _resize_latency(
+            grow_ev, grow_final - 1, grow_final),
+        "steps_per_s_before": _elastic_rate(grow_ev, 1),
+        "steps_per_s_after": _elastic_rate(grow_ev, grow_final),
+    }
+
+    return {
+        "metric": "elastic_shrink_resize_to_first_step_seconds",
+        "value": shrink["resize_to_first_step_seconds"],
+        "unit": "s",
+        "ok": bool(shrink_res.ok and grow_res.ok
+                   and shrink_res.world_size == 2
+                   and grow_res.world_size == 4),
+        "shrink": shrink,
+        "grow": grow,
+        "note": "supervised XLA:CPU gangs (1 device/process) on a 1-core "
+                "box; latency spans process spawn, jax init, N'-gang "
+                "formation, sharded N->N' checkpoint restore through the "
+                "block index, and jit recompile. steps/s are rank-0 "
+                "dispatch rates excluding each attempt's compile step — "
+                "on this box all workers share one core, so the per-world "
+                "rates measure dispatch overhead, not chip throughput",
+    }
+
+
 # ------------------------------------------------------------ long context --
 def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
                            (1, 8192, True), (1, 16384, True),
@@ -1306,7 +1562,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
                 "resnet50", "lm")):
     known = {"mnist", "multistep", "overlap", "convergence", "cifar",
              "resnet50", "lm", "longctx", "resilience", "zero", "precision",
-             "compile_cache", "serve"}
+             "compile_cache", "serve", "elastic"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -1348,6 +1604,10 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # Opt-in: continuous batching + paged KV serving vs static-batch
         # generate() (BENCH_serve.json; docs/SERVING.md).
         extra.append(bench_serve())
+    if "elastic" in modes:
+        # Opt-in: elastic gang 4->2->4 resize-to-first-step latency
+        # (BENCH_elastic.json; docs/RESILIENCE.md "Elastic gangs").
+        extra.append(bench_elastic())
     result = headline or extra.pop(0)
     if extra:
         result["extra"] = extra

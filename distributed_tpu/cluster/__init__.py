@@ -1,5 +1,15 @@
 from .config import ClusterSpec, from_barrier, from_env, resolve
-from .init import barrier, initialize, is_chief, is_initialized, process_count, process_index
+from .init import (
+    ELASTIC_WORLD_ENV,
+    barrier,
+    initialize,
+    is_chief,
+    is_initialized,
+    process_count,
+    process_index,
+    reset_for_relaunch,
+    shutdown,
+)
 from .net import check_reachable, free_port, my_ip, preflight
 
 __all__ = [
@@ -9,8 +19,11 @@ __all__ = [
     "resolve",
     "initialize",
     "is_initialized",
+    "reset_for_relaunch",
+    "shutdown",
     "is_chief",
     "barrier",
+    "ELASTIC_WORLD_ENV",
     "process_index",
     "process_count",
     "my_ip",

@@ -24,6 +24,11 @@ from . import config as config_lib
 _initialized = False
 _gathered_cache = None  # explicit-coordinator spec, cached after the gather
 
+# Elastic world-size override, exported by a resizing Supervisor: the
+# relaunched gang must form a clean N'-process runtime even when the
+# inherited DTPU_CONFIG/TF_CONFIG still names the old N workers.
+ELASTIC_WORLD_ENV = "DTPU_ELASTIC_WORLD"
+
 
 def _enable_cpu_collectives():
     """Give a multi-process CPU gang a working collectives layer.
@@ -77,6 +82,49 @@ def _gathered_workers(coordinator: str, n: int, index: int) -> list:
         bytes(row).rstrip(b"\x00").decode(errors="replace")
         for row in np.asarray(gathered)
     ]
+
+
+def _apply_elastic_world(
+    spec: config_lib.ClusterSpec,
+) -> config_lib.ClusterSpec:
+    """Honor ``DTPU_ELASTIC_WORLD`` over an env-inherited spec: truncate
+    the worker list to the elastic world's first N' entries (rank order is
+    the supervisor's contract — surviving workers keep a dense rank
+    prefix). A rank outside the new world must not join at all: raising
+    here beats N' workers hanging at a collective waiting for a ghost.
+    Growing past the inherited list is impossible from this side (the
+    override carries no addresses) — the launcher regenerates the spec on
+    a real grow, so warn and keep the spec."""
+    raw = os.environ.get(ELASTIC_WORLD_ENV)
+    if not raw:
+        return spec
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ELASTIC_WORLD_ENV} must be an integer, got {raw!r}"
+        )
+    if n < 1:
+        raise ValueError(f"{ELASTIC_WORLD_ENV} must be >= 1, got {n}")
+    if n == spec.num_processes:
+        return spec
+    if n > spec.num_processes:
+        dlog.warning(
+            f"{ELASTIC_WORLD_ENV}={n} exceeds the inherited spec's "
+            f"{spec.num_processes} workers; an elastic grow needs a "
+            "regenerated spec (the override carries no addresses) — "
+            "keeping the inherited spec"
+        )
+        return spec
+    if spec.index >= n:
+        raise ValueError(
+            f"rank {spec.index} is outside the elastic world of {n} "
+            f"(inherited spec had {spec.num_processes} workers); this "
+            "process should not have been launched"
+        )
+    return config_lib.ClusterSpec(
+        workers=list(spec.workers[:n]), index=spec.index
+    ).validate()
 
 
 def _tpu_pod_spec() -> Optional[config_lib.ClusterSpec]:
@@ -152,8 +200,14 @@ def initialize(
         )
         return _gathered_cache
 
+    explicit = spec is not None
     spec = config_lib.resolve(spec)
     if spec is not None:
+        if not explicit:
+            # Env-inherited specs can be stale across an elastic resize;
+            # an explicitly passed spec is the caller's authority and is
+            # never rewritten.
+            spec = _apply_elastic_world(spec)
         # An explicit/env spec always wins — including a single-process one
         # (debugging one worker on a pod VM must not be hijacked by
         # auto-detect).
@@ -214,6 +268,37 @@ def initialize(
 
 def is_initialized() -> bool:
     return _initialized
+
+
+def reset_for_relaunch() -> None:
+    """Clear the module's memo state (``_initialized`` guard and the
+    explicit-coordinator spec cache) so a re-formed — possibly resized —
+    gang can ``initialize()`` cleanly in the same process. Without this an
+    in-process relaunch silently reuses the stale cached spec: the old
+    world size, the old coordinator, the old rank.
+
+    This clears bookkeeping only; it does NOT tear down a live
+    ``jax.distributed`` runtime — use :func:`shutdown` when this process
+    actually joined one. (Single-process test gangs and the
+    explicit-coordinator n=1 path never start the runtime, so for them
+    this is the complete reset.)"""
+    global _initialized, _gathered_cache
+    _initialized = False
+    _gathered_cache = None
+
+
+def shutdown() -> None:
+    """Leave the cluster: tear down ``jax.distributed`` (when this process
+    initialized it) and clear the memo state, making ``initialize()``
+    re-formable at a new world size. Best-effort on the runtime teardown —
+    a coordinator that already died must not turn a relaunch into a crash."""
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # dead coordinator / already torn down
+            dlog.warning(f"jax.distributed shutdown failed (ignored): {e}")
+    reset_for_relaunch()
 
 
 def process_index() -> int:

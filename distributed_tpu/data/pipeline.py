@@ -135,7 +135,10 @@ class Pipeline:
         global-batch semantics unchanged, per-host memory and prep work
         divided by ``count`` (SURVEY.md §7 hard parts; contrast the
         reference's full-dataset-everywhere feeding,
-        /root/reference/README.md:369-373).
+        /root/reference/README.md:369-373). ``shard="auto"`` derives
+        ``(jax.process_index(), jax.process_count())`` from the live
+        runtime — the right spelling for elastic gangs, where the world
+        size differs between relaunches (see :meth:`reshard`).
 
     The stream is infinite (passes repeat, reshuffled); ``steps_per_pass``
     tells one epoch's length, matching ``fit(steps_per_epoch=...)``.
@@ -198,6 +201,39 @@ class Pipeline:
         if self._y is not None and len(self._y) != n_rows:
             raise ValueError("x and y lengths differ")
         self.batch_size = int(batch_size)
+        self._row_shape = tuple(row_shape)
+        self._set_shard(shard)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.scale = float(scale)
+        self.prefetch = max(1, int(prefetch))
+        self.num_threads = max(1, int(num_threads))
+        self._n = int(n_rows)
+        self.steps_per_pass = self._n // self.batch_size
+        self._row = int(np.prod(row_shape, dtype=np.int64))
+
+        lib = _load_native() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("Native pipeline requested but unavailable")
+        self._lib = lib
+        if lib is not None:
+            self._handle = self._create_handle(0)
+
+    def _set_shard(self, shard) -> None:
+        """Validate + adopt a ``(index, count)`` slice of the global batch
+        (None -> unsharded, "auto" -> the live process's rank/world).
+        Shared by ``__init__`` and :meth:`reshard` so both agree on what a
+        legal shard is; emitted shape follows (``batch_size`` stays the
+        GLOBAL batch)."""
+        if isinstance(shard, str):
+            if shard != "auto":
+                raise ValueError(
+                    f"shard must be (index, count), None, or 'auto'; "
+                    f"got {shard!r}"
+                )
+            import jax
+
+            shard = (jax.process_index(), jax.process_count())
         if shard is None:
             shard = (0, 1)
         index, count = (int(shard[0]), int(shard[1]))
@@ -210,23 +246,30 @@ class Pipeline:
             )
         self.shard = (index, count) if count > 1 else None
         self.shard_rows = self.batch_size // count
-        self.shuffle = bool(shuffle)
-        self.seed = int(seed)
-        self.scale = float(scale)
-        self.prefetch = max(1, int(prefetch))
-        self.num_threads = max(1, int(num_threads))
-        self._n = int(n_rows)
-        self.steps_per_pass = self._n // self.batch_size
-        # Emitted (local) shape; batch_size stays the global batch.
-        self.batch_shape = (self.shard_rows,) + tuple(row_shape)
-        self._row = int(np.prod(row_shape, dtype=np.int64))
+        self.batch_shape = (self.shard_rows,) + self._row_shape
 
-        lib = _load_native() if use_native in (None, True) else None
-        if use_native is True and lib is None:
-            raise RuntimeError("Native pipeline requested but unavailable")
-        self._lib = lib
-        if lib is not None:
-            self._handle = self._create_handle(0)
+    def reshard(self, shard) -> "Pipeline":
+        """Adopt a new ``(index, count)`` slice of the SAME global batch
+        stream at the current position — the elastic-resize primitive. The
+        global sequence depends only on (seed, pass, step), so after
+        ``reshard`` the next emitted batch is this shard's rows of exactly
+        the global batch the old sharding would have assembled next: the
+        re-formed gang's slices still concatenate into the unsharded
+        stream, and the loss trajectory is preserved across the resize
+        (docs/RESILIENCE.md "Elastic gangs"). ``shard="auto"`` re-derives
+        ``(process_index, process_count)`` from the live runtime. O(1) —
+        the native ring is recreated at the current step, nothing is
+        replayed or re-prepared."""
+        if self._closed:
+            raise ValueError("Pipeline is closed")
+        self._set_shard(shard)
+        if self._handle is not None:
+            # Same detach-before-recreate dance as seek(): a failed
+            # recreate must not leave a handle close() would double-free.
+            handle, self._handle = self._handle, None
+            self._lib.dtpu_pipeline_destroy(handle)
+            self._handle = self._create_handle(self.steps_emitted)
+        return self
 
     def _create_handle(self, start_step: int):
         # One span for an in-memory array; one per memory-mapped shard for

@@ -50,6 +50,22 @@ def main(argv=None):
     ap.add_argument("--checkpoint-dir", type=str, default=None,
                     help="(with --supervise) the run's checkpoint dir, for "
                          "resume-state events and marker cleanup")
+    ap.add_argument("--elastic-min-workers", type=int, default=None,
+                    help="(with --supervise) enable elastic gang "
+                         "re-formation: on PERMANENT worker loss (the same "
+                         "rank initiating the failure on consecutive "
+                         "attempts) relaunch at a smaller world size down "
+                         "to this floor, budget-free, instead of burning "
+                         "the restart budget on a doomed fixed-size "
+                         "relaunch (docs/RESILIENCE.md 'Elastic gangs')")
+    ap.add_argument("--elastic-max-workers", type=int, default=None,
+                    help="(with --elastic-min-workers) ceiling for "
+                         "grow-back; default: the launch size")
+    ap.add_argument("--elastic-divisor", type=int, default=None,
+                    help="(with --elastic-min-workers) snap every resized "
+                         "world size down to a divisor of this — set it to "
+                         "the global batch size so resizes keep exact "
+                         "batch math")
     ap.add_argument("--event-log", type=str, default=None,
                     help="(with --supervise) JSONL event log path; also "
                          "exported to workers as DTPU_EVENT_LOG")
@@ -71,12 +87,20 @@ def main(argv=None):
                   "liveness_timeout": args.liveness_timeout}
 
     if args.supervise:
-        from ..resilience import RestartPolicy, Supervisor
+        from ..resilience import ElasticPolicy, RestartPolicy, Supervisor
         from ..utils.events import EventLog
 
+        elastic = None
+        if args.elastic_min_workers is not None:
+            elastic = ElasticPolicy(
+                min_workers=args.elastic_min_workers,
+                max_workers=args.elastic_max_workers,
+                divisor_of=args.elastic_divisor,
+            )
         sup = Supervisor(
             worker_argv, n, launcher=launcher,
             policy=RestartPolicy(max_restarts=args.max_restarts or 3),
+            elastic=elastic,
             checkpoint_dir=args.checkpoint_dir,
             event_log=EventLog(args.event_log) if args.event_log else None,
             liveness_timeout=args.liveness_timeout,
@@ -89,7 +113,10 @@ def main(argv=None):
         results = sup_result.results
         print(f"supervisor: attempts={sup_result.attempts} "
               f"restarts={sup_result.restarts_used} "
-              f"preemptions={sup_result.preemptions}")
+              f"preemptions={sup_result.preemptions}"
+              + (f" resizes={sup_result.resizes} "
+                 f"world_size={sup_result.world_size}"
+                 if elastic is not None else ""))
     elif args.hosts:
         results = core.run_with_restart(
             launcher, worker_argv, max_restarts=args.max_restarts, **run_kw

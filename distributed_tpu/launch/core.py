@@ -83,7 +83,20 @@ def heartbeat(min_interval: float = 0.5) -> None:
 @dataclasses.dataclass
 class WorkerResult:
     """One row per worker — the shape of the reference's Spark collect()
-    (/root/reference/README.md:223-232)."""
+    (/root/reference/README.md:223-232).
+
+    ``disposition`` records HOW the row ended, structurally — the launcher
+    knows whether it killed the worker and why, and downstream policy
+    (the supervisor's preemption/failure classification, the elastic
+    ledger's per-rank attribution) must not re-derive that from error
+    strings. Values: ``"exited"`` (the worker's own exit, code in
+    ``exit_code``), ``"gang_killed"`` (killed because a PEER failed —
+    collateral, never an independent fault), ``"liveness_killed"``
+    (heartbeat went silent: hung, an initiated fault), ``"timeout"``
+    (the whole run deadline expired — unattributable), ``"launch_error"``
+    (the gang never started). ``None`` on rows from launchers predating
+    the field; consumers then fall back to exit_code/error heuristics.
+    """
 
     index: int
     ok: bool
@@ -91,6 +104,7 @@ class WorkerResult:
     error: Optional[str] = None  # exception text, tryCatch-style
     exit_code: Optional[int] = None
     log_tail: str = ""
+    disposition: Optional[str] = None
 
 
 def report_result(value):
@@ -187,7 +201,7 @@ class LocalLauncher:
         pending = set(range(num_workers))
         first_failure: Optional[float] = None
 
-        def kill_and_record(i: int, reason: str):
+        def kill_and_record(i: int, reason: str, disposition: str):
             proc, _ = procs[i]
             proc.kill()
             proc.wait()
@@ -199,6 +213,7 @@ class LocalLauncher:
                 error=reason,
                 exit_code=None,
                 log_tail=_tail(tmp / f"worker-{i}.log"),
+                disposition=disposition,
             )
 
         while pending:
@@ -218,6 +233,7 @@ class LocalLauncher:
                         error=err,
                         exit_code=rc,
                         log_tail=_tail(log_path) if rc != 0 else "",
+                        disposition="exited",
                     )
                     if rc != 0 and first_failure is None:
                         first_failure = now
@@ -233,6 +249,7 @@ class LocalLauncher:
                         i,
                         f"liveness timeout (no heartbeat for "
                         f"{liveness_timeout:.0f}s; worker hung?)",
+                        "liveness_killed",
                     )
                     if first_failure is None:
                         first_failure = now
@@ -240,13 +257,16 @@ class LocalLauncher:
                 now > deadline
                 or (first_failure is not None and now > first_failure + grace)
             ):
+                timed_out = now > deadline
                 reason = (
                     "timeout"
-                    if now > deadline
+                    if timed_out
                     else "killed after peer failure (gang semantics)"
                 )
                 for i in list(pending):
-                    kill_and_record(i, reason)
+                    kill_and_record(
+                        i, reason, "timeout" if timed_out else "gang_killed"
+                    )
                 pending.clear()
             time.sleep(0.05)
         for proc, log in procs:
@@ -400,8 +420,9 @@ class SSHLauncher:
             if now > deadline or (
                 first_failure is not None and now > first_failure + grace
             ):
+                killed_timeout = now > deadline
                 kill_reason = (
-                    "timeout" if now > deadline
+                    "timeout" if killed_timeout
                     else "killed after peer failure (gang semantics)"
                 )
                 for i, p in enumerate(procs):
@@ -431,16 +452,19 @@ class SSHLauncher:
                     except json.JSONDecodeError:
                         pass
             if proc.returncode == 0 and i not in hung:
-                err = None
+                err, disposition = None, "exited"
             elif i in hung:
                 err = (
                     f"liveness timeout (no heartbeat for "
                     f"{liveness_timeout:.0f}s; worker hung?)"
                 )
+                disposition = "liveness_killed"
             elif i in killed:
                 err = kill_reason
+                disposition = "timeout" if killed_timeout else "gang_killed"
             else:
                 err = f"exit code {proc.returncode}"
+                disposition = "exited"
             ok = proc.returncode == 0 and i not in hung
             results.append(
                 WorkerResult(
@@ -448,8 +472,13 @@ class SSHLauncher:
                     ok=ok,
                     value=value,
                     error=err,
-                    exit_code=proc.returncode,
+                    # A launcher-killed worker's returncode is the kill
+                    # signal, not its own exit — report None so exit-
+                    # disposition consumers never mistake it for a fault.
+                    exit_code=(proc.returncode
+                               if disposition == "exited" else None),
                     log_tail="" if ok else (out or "")[-4096:],
+                    disposition=disposition,
                 )
             )
         return results

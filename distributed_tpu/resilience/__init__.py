@@ -7,6 +7,11 @@ closes that gap with four cooperating pieces:
 - :class:`Supervisor` — launches/monitors worker gangs (heartbeat liveness,
   exponential-backoff restarts, max-restart budget, structured event log).
 - :class:`RestartPolicy` — the restart budget/backoff as a testable value.
+- :class:`ElasticPolicy` — elastic gang re-formation: permanent worker loss
+  (per-rank failure attribution, or a capacity probe) relaunches the same
+  command at a new world size instead of burning the budget; capacity
+  regained grows the gang back (see ``elastic.py``, docs/RESILIENCE.md
+  "Elastic gangs").
 - :class:`PreemptionHandler` — SIGTERM -> final checkpoint -> resume marker
   -> exit :data:`PREEMPTED_EXIT_CODE` (restart is budget-free).
 - :class:`FaultInjector` — kill / hang / slow-heartbeat / corrupt-checkpoint
@@ -21,6 +26,7 @@ docs/RESILIENCE.md.
 """
 
 from ..utils.events import EventLog, read_events
+from .elastic import ElasticPolicy, FailureLedger
 from .faults import FaultInjector, corrupt_latest_checkpoint
 from .policy import RestartPolicy
 from .preemption import (
@@ -37,6 +43,8 @@ __all__ = [
     "SupervisedResult",
     "supervise",
     "RestartPolicy",
+    "ElasticPolicy",
+    "FailureLedger",
     "PreemptionHandler",
     "PREEMPTED_EXIT_CODE",
     "FaultInjector",

@@ -17,17 +17,25 @@ restores the latest *valid* checkpoint (corrupt files are skipped, see
 ``checkpoint.core``) and fast-forwards the batch stream — the supervised
 run converges bit-identically to an uninterrupted one, modulo the replayed
 partial epoch.
+
+Elastic mode (``Supervisor(elastic=ElasticPolicy(...))``) extends the
+relaunch with a per-attempt world size: a *permanent* worker loss —
+detected by per-rank failure attribution across attempts, or reported by
+a capacity probe — re-forms the gang at a new size N′ (budget-free, see
+``elastic.py``) instead of burning the restart budget on a doomed fixed-N
+relaunch, and grows back toward ``max_workers`` when capacity returns.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..launch.core import LocalLauncher, WorkerResult
 from ..utils import events as events_lib
 from ..utils import logging as dlog
+from .elastic import ElasticPolicy, FailureLedger
 from .policy import RestartPolicy
 from .preemption import (
     PREEMPTED_EXIT_CODE,
@@ -35,11 +43,17 @@ from .preemption import (
     read_resume_marker,
 )
 
+# Mirrors cluster.init.ELASTIC_WORLD_ENV (not imported: cluster.init pulls
+# in jax, and the supervisor must stay importable on jax-free controllers).
+ELASTIC_WORLD_ENV = "DTPU_ELASTIC_WORLD"
+
 
 @dataclasses.dataclass
 class SupervisedResult:
     """Outcome of a supervised run: final-attempt worker rows plus the
-    restart accounting a caller needs to reason about what happened."""
+    restart accounting a caller needs to reason about what happened.
+    ``resizes`` counts elastic gang re-formations and ``world_size`` is the
+    final attempt's gang size (== the launch size for fixed-size runs)."""
 
     ok: bool
     attempts: int
@@ -47,24 +61,57 @@ class SupervisedResult:
     preemptions: int
     results: List[WorkerResult]
     event_log: Optional[str] = None
+    resizes: int = 0
+    world_size: Optional[int] = None
 
     @property
     def failed(self) -> List[WorkerResult]:
         return [r for r in self.results if not r.ok]
 
 
+def _gang_collateral(r: WorkerResult) -> bool:
+    """True for a row the launcher killed because of a PEER — a consequence
+    of someone else's failure, never an independent fault. Decided from the
+    launcher's structural disposition; rows from launchers predating the
+    field fall back to exit disposition (a gang-killed worker never exits
+    on its own, so it has no exit code) minus the other no-exit-code kills,
+    which carry their reason in ``error``."""
+    if r.disposition is not None:
+        return r.disposition == "gang_killed"
+    err = r.error or ""
+    return r.exit_code is None and "liveness" not in err and "timeout" not in err
+
+
+def _initiated(r: WorkerResult) -> bool:
+    """True when this rank's own behavior started the gang failure — the
+    rows the elastic ledger attributes. Collateral gang-kills, preemptions,
+    whole-run timeouts, and launch errors don't count: those synthesize a
+    row for EVERY rank, and blaming everyone is blaming no one (a dead
+    coordinator is not rank 0's fault)."""
+    if r.ok or r.exit_code == PREEMPTED_EXIT_CODE:
+        return False
+    if r.disposition in ("timeout", "launch_error"):
+        return False
+    if "timeout" == (r.error or ""):
+        return False
+    return not _gang_collateral(r)
+
+
 def _classify_preemption(failed: Sequence[WorkerResult]) -> bool:
     """True when the attempt ended by preemption: at least one worker took
-    the PreemptionHandler exit, and every other failure is either the same
-    or the launcher's gang-kill of its peers (which is a consequence of the
-    preemption, not an independent fault)."""
+    the PreemptionHandler exit, and every other failure is the same or the
+    launcher's gang-kill of its peers (a consequence of the preemption, not
+    an independent fault). Collateral is judged by exit disposition — an
+    error-string match would misread a peer row whose ``error`` is None
+    and burn restart budget on a clean preemption."""
     if not failed:
         return False
-    preempted = [r for r in failed if r.exit_code == PREEMPTED_EXIT_CODE]
-    if not preempted:
+    if not any(r.exit_code == PREEMPTED_EXIT_CODE for r in failed):
         return False
-    rest = [r for r in failed if r.exit_code != PREEMPTED_EXIT_CODE]
-    return all("peer failure" in (r.error or "") for r in rest)
+    return all(
+        r.exit_code == PREEMPTED_EXIT_CODE or _gang_collateral(r)
+        for r in failed
+    )
 
 
 class Supervisor:
@@ -78,6 +125,14 @@ class Supervisor:
     completes. ``liveness_timeout`` arms the launcher's heartbeat probe so
     hangs are restartable too, not just crashes.
 
+    ``elastic``: an :class:`~distributed_tpu.resilience.ElasticPolicy`
+    opts into gang re-formation at a new world size on permanent worker
+    loss (and grow-back under a capacity probe). Each attempt's size rides
+    the launcher (``num_workers`` for local launchers, a host-list prefix
+    for SSH-style ones — permanently-lost ranks' hosts are excluded before
+    trimming) and is exported to workers as ``DTPU_ELASTIC_WORLD`` so
+    ``cluster.initialize()`` overrides any stale inherited spec.
+
     ``sleep`` is injectable for tests (backoff schedules assert without
     waiting them out).
     """
@@ -89,6 +144,7 @@ class Supervisor:
         *,
         launcher=None,
         policy: Optional[RestartPolicy] = None,
+        elastic: Optional[ElasticPolicy] = None,
         checkpoint_dir=None,
         event_log: Optional[events_lib.EventLog] = None,
         env_extra: Optional[Dict[str, str]] = None,
@@ -99,11 +155,19 @@ class Supervisor:
         self.num_workers = int(num_workers)
         self.launcher = launcher if launcher is not None else LocalLauncher()
         self.policy = policy or RestartPolicy()
+        self.elastic = elastic
         self.checkpoint_dir = checkpoint_dir
         self.event_log = event_log
         self.env_extra = dict(env_extra or {})
         self.liveness_timeout = liveness_timeout
         self._sleep = sleep
+        # SSH-style launchers derive the gang from a host list; elastic
+        # resizes then operate on this working copy (lost ranks' hosts
+        # excluded, excluded hosts re-admitted on grow, prefix trimmed to
+        # the world size). None for sized (LocalLauncher-style) launchers.
+        hosts = getattr(self.launcher, "hosts", None)
+        self._all_hosts = list(hosts) if hosts else None
+        self._active_hosts = list(hosts) if hosts else None
 
     # ------------------------------------------------------------------ event
     def _emit(self, kind: str, **fields):
@@ -114,40 +178,115 @@ class Supervisor:
                 pass
 
     # ----------------------------------------------------------------- launch
-    def _attempt_env(self, attempt: int) -> Dict[str, str]:
+    def _attempt_env(self, attempt: int, world: int) -> Dict[str, str]:
         env = dict(self.env_extra)
         env["DTPU_ATTEMPT"] = str(attempt)
+        if self.elastic is not None:
+            # The relaunched workers must form a clean N'-process runtime
+            # even when a stale N-worker spec is inherited from the
+            # environment (cluster/init.py honors this override).
+            env[ELASTIC_WORLD_ENV] = str(world)
         if self.event_log is not None:
             env[events_lib.ENV_VAR] = str(self.event_log.path)
         return env
 
-    def _launch(self, attempt: int, timeout: float, grace: float,
+    def _launch(self, attempt: int, world: int, timeout: float, grace: float,
                 **launch_kw) -> List[WorkerResult]:
-        env = self._attempt_env(attempt)
+        env = self._attempt_env(attempt, world)
         kw = dict(timeout=timeout, grace=grace, **launch_kw)
         if self.liveness_timeout is not None:
             kw.setdefault("liveness_timeout", self.liveness_timeout)
         try:
             if hasattr(self.launcher, "env_extra"):
-                # LocalLauncher-style: env rides the launcher instance.
+                # LocalLauncher-style: env rides the launcher instance and
+                # the gang size is this attempt's world.
                 saved = self.launcher.env_extra
                 self.launcher.env_extra = {**saved, **env}
                 try:
-                    return self.launcher.run(self.argv, self.num_workers, **kw)
+                    return self.launcher.run(self.argv, world, **kw)
                 finally:
                     self.launcher.env_extra = saved
             # SSHLauncher-style: env is a run kwarg, gang size comes from
-            # the launcher's host list.
+            # the launcher's host list — which elastic resizes rewrite
+            # (self._active_hosts), so launch through the working copy.
+            if self._active_hosts is not None:
+                saved_hosts = self.launcher.hosts
+                self.launcher.hosts = list(self._active_hosts)
+                try:
+                    return self.launcher.run(self.argv, env_extra=env, **kw)
+                finally:
+                    self.launcher.hosts = saved_hosts
             return self.launcher.run(self.argv, env_extra=env, **kw)
         except RuntimeError as e:
             # Keep the errors-as-data contract (same as run_with_restart):
             # a preflight failure on relaunch becomes one failed row per
             # expected worker, so result shape is stable across attempts.
-            n = len(getattr(self.launcher, "hosts", None) or []) or self.num_workers
             return [
-                WorkerResult(index=i, ok=False, error=str(e))
-                for i in range(n)
+                WorkerResult(index=i, ok=False, error=str(e),
+                             disposition="launch_error")
+                for i in range(world)
             ]
+
+    # ---------------------------------------------------------------- elastic
+    def _elastic_candidate(
+        self, world: int, default_max: int, preempted: bool,
+        failed: Sequence[WorkerResult], ledger: FailureLedger, resizes: int,
+    ) -> Optional[Tuple[int, dict]]:
+        """The (new_world, event_fields) this restart boundary should
+        re-form to, or None to keep the fixed-size behavior. Probe wins
+        over attribution (an explicit capacity signal both shrinks and
+        grows); attribution only ever shrinks — it cannot observe
+        returning capacity. ``default_max`` is the run's launch size, the
+        grow ceiling when the policy sets no ``max_workers``."""
+        if self.elastic is None:
+            return None
+        lost: Tuple[int, ...] = ()
+        if not preempted:
+            ledger.record(r.index for r in failed if _initiated(r))
+        if self.elastic.probe is not None:
+            cand = self.elastic.snap(int(self.elastic.probe()), default_max)
+            trigger = "probe"
+        else:
+            lost = tuple(sorted(
+                r for r in ledger.permanent(self.elastic.failure_threshold)
+                if r < world
+            ))
+            if not lost:
+                return None
+            cand = self.elastic.snap(world - len(lost), default_max)
+            if cand is not None and cand >= world:
+                cand = None  # attribution never grows
+            trigger = "attribution"
+        if cand is None or cand == world:
+            return None
+        if resizes >= self.elastic.max_resizes:
+            self._emit("resize_cap_exhausted", resizes=resizes,
+                       wanted_world=cand)
+            return None
+        return cand, {
+            "reason": "shrink" if cand < world else "grow",
+            "trigger": trigger,
+            "lost_ranks": list(lost),
+        }
+
+    def _apply_resize(self, world: int, new_world: int,
+                      lost_ranks: Sequence[int]) -> None:
+        """Rewrite the SSH-style working host list for the new world:
+        permanently-lost ranks' hosts are excluded first (a shrink must
+        route AROUND the bad host, not just truncate onto it), then the
+        list is grown back from excluded hosts (original order) or trimmed
+        to the world size."""
+        if self._active_hosts is None:
+            return
+        active = [h for i, h in enumerate(self._active_hosts)
+                  if i not in set(lost_ranks)]
+        if len(active) < new_world:
+            for h in self._all_hosts:
+                if len(active) >= new_world:
+                    break
+                if h not in active:
+                    active.append(h)
+        self._active_hosts = active[:new_world]
 
     # -------------------------------------------------------------------- run
     def run(self, *, timeout: float = 600.0, grace: float = 10.0,
@@ -155,19 +294,41 @@ class Supervisor:
         """Supervise until success, budget exhaustion, or preemption-cap.
 
         Returns the final attempt's per-worker rows (errors as data, never
-        an exception) wrapped with restart accounting."""
+        an exception) wrapped with restart accounting. Under an elastic
+        policy the gang may complete at a different world size than it
+        launched (``SupervisedResult.world_size`` / ``resizes``)."""
         attempt = 0
         restarts_used = 0
         preemptions = 0
+        resizes = 0
+        ledger = FailureLedger()
+        world = (len(self._active_hosts) if self._active_hosts is not None
+                 else self.num_workers)
+        launch_world = world  # the grow ceiling when max_workers is unset
+        if self.elastic is not None and self.elastic.probe is not None:
+            # Launch at today's capacity, not the requested size — a run
+            # started while the cluster is short shouldn't burn its budget
+            # discovering that.
+            cand = self.elastic.snap(int(self.elastic.probe()), launch_world)
+            if cand is not None and cand != world:
+                resizes += 1
+                self._emit("gang_resize", from_world=world, to_world=cand,
+                           reason="shrink" if cand < world else "grow",
+                           trigger="probe", lost_ranks=[], attempt=0)
+                self._apply_resize(world, cand, ())
+                world = cand
         while True:
             attempt += 1
-            self._emit("attempt_start", attempt=attempt,
-                       restarts_used=restarts_used, preemptions=preemptions)
+            self._emit("attempt_start", attempt=attempt, world_size=world,
+                       restarts_used=restarts_used, preemptions=preemptions,
+                       resizes=resizes)
             t0 = time.monotonic()
-            results = self._launch(attempt, timeout, grace, **launch_kw)
+            results = self._launch(attempt, world, timeout, grace,
+                                   **launch_kw)
             failed = [r for r in results if not r.ok]
             self._emit(
                 "attempt_end", attempt=attempt, ok=not failed,
+                world_size=world,
                 duration=round(time.monotonic() - t0, 3),
                 failed_ranks=[r.index for r in failed],
                 exit_codes=[r.exit_code for r in failed],
@@ -177,10 +338,13 @@ class Supervisor:
                     clear_resume_marker(self.checkpoint_dir)
                 self._emit("run_complete", attempts=attempt,
                            restarts_used=restarts_used,
-                           preemptions=preemptions)
+                           preemptions=preemptions, resizes=resizes,
+                           world_size=world)
                 return self._result(True, attempt, restarts_used,
-                                    preemptions, results)
+                                    preemptions, results, resizes, world)
             preempted = _classify_preemption(failed)
+            resize = self._elastic_candidate(world, launch_world, preempted,
+                                             failed, ledger, resizes)
             if preempted and self.policy.preemption_exempt:
                 if not self.policy.allows_preemption_restart(preemptions):
                     self._emit("preemption_cap_exhausted",
@@ -190,9 +354,14 @@ class Supervisor:
                         f"({self.policy.max_preemptions}) exhausted"
                     )
                     return self._result(False, attempt, restarts_used,
-                                        preemptions, results)
+                                        preemptions, results, resizes, world)
                 preemptions += 1
                 delay, reason = 0.0, "preempted"
+            elif resize is not None:
+                # Re-forming the gang at a new size is capacity management,
+                # not a defect of the job: budget-free, like preemption
+                # (bounded by ElasticPolicy.max_resizes).
+                delay, reason = 0.0, "resize"
             else:
                 if not self.policy.allows_restart(restarts_used):
                     self._emit("budget_exhausted",
@@ -203,19 +372,36 @@ class Supervisor:
                         f"({self.policy.max_restarts} restarts); giving up"
                     )
                     return self._result(False, attempt, restarts_used,
-                                        preemptions, results)
+                                        preemptions, results, resizes, world)
                 restarts_used += 1
                 delay = self.policy.delay(restarts_used)
                 reason = "preempted" if preempted else "failure"
+            if resize is not None:
+                new_world, info = resize
+                resizes += 1
+                ledger.reset()  # a re-formed gang renumbers its ranks
+                self._emit("gang_resize", from_world=world,
+                           to_world=new_world, attempt=attempt, **info)
+                dlog.warning(
+                    f"Supervisor: {info['reason']} gang {world} -> "
+                    f"{new_world} workers ({info['trigger']}"
+                    + (f", lost ranks {info['lost_ranks']}"
+                       if info["lost_ranks"] else "")
+                    + ")"
+                )
+                self._apply_resize(world, new_world, info["lost_ranks"])
+                world = new_world
             resume = self._resume_state()
             self._emit("restart", attempt=attempt + 1, reason=reason,
-                       delay=delay, restarts_used=restarts_used,
-                       preemptions=preemptions, **resume)
+                       world_size=world, delay=delay,
+                       restarts_used=restarts_used,
+                       preemptions=preemptions, resizes=resizes, **resume)
             dlog.warning(
                 f"Supervisor: {reason} on worker(s) "
                 f"{[r.index for r in failed]}; relaunching in {delay:.1f}s "
+                f"at world size {world} "
                 f"(restarts {restarts_used}/{self.policy.max_restarts}, "
-                f"preemptions {preemptions})"
+                f"preemptions {preemptions}, resizes {resizes})"
                 + (f", resume from step {resume['resume_step']}"
                    if resume.get("resume_step") is not None else "")
             )
@@ -237,7 +423,8 @@ class Supervisor:
             "marker_step": marker["step"] if marker else None,
         }
 
-    def _result(self, ok, attempts, restarts_used, preemptions, results):
+    def _result(self, ok, attempts, restarts_used, preemptions, results,
+                resizes=0, world_size=None):
         return SupervisedResult(
             ok=ok,
             attempts=attempts,
@@ -246,6 +433,8 @@ class Supervisor:
             results=results,
             event_log=(str(self.event_log.path)
                        if self.event_log is not None else None),
+            resizes=resizes,
+            world_size=world_size,
         )
 
 

@@ -132,8 +132,27 @@ def _per_host_source(source) -> bool:
     tuple, the shape data.Pipeline(shard=...) sets. NOT any ``shard``
     attribute: a tf.data-style .shard() METHOD must not trigger per-host
     placement. One definition shared by fit/evaluate/predict so the three
-    entry points cannot disagree about what counts as a sharded source."""
-    return isinstance(getattr(source, "shard", None), tuple)
+    entry points cannot disagree about what counts as a sharded source.
+
+    A sharded source whose shard count disagrees with the live world size
+    raises here, on all three entry points: the slices could never
+    assemble into a whole global batch, and the canonical way to hit this
+    is a pipeline held across an elastic gang resize."""
+    shard = getattr(source, "shard", None)
+    if not isinstance(shard, tuple):
+        return False
+    count = int(shard[1])
+    if count != jax.process_count():
+        raise ValueError(
+            f"per-host-sharded data source splits each global batch "
+            f"{count} ways but this runtime has {jax.process_count()} "
+            "process(es), so the shards cannot assemble into a whole "
+            "batch (each process would feed the wrong fraction). After an "
+            "elastic gang resize, rebuild the pipeline from the current "
+            "cluster spec, call pipeline.reshard('auto'), or construct "
+            "it with shard='auto'."
+        )
+    return True
 
 
 class Model:

@@ -54,6 +54,18 @@ if [ -n "${TIER1_SERVE_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_ELASTIC_SMOKE=1: same idea for the elastic-gang subsystem — runs
+# the elastic policy/supervisor/cluster/pipeline units plus the N->N'
+# sharded-restore tests (~15 s). The real-gang shrink/grow fault matrix
+# stays @slow (run it explicitly with -m slow when touching the gang
+# paths). NOT a tier-1 substitute.
+if [ -n "${TIER1_ELASTIC_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py \
+        "tests/test_sharded_checkpoint.py::TestElasticRestore" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

@@ -350,6 +350,58 @@ class TestSupervisorUnit:
         out = sup.run(timeout=5)
         assert out.ok and out.preemptions == 1 and out.restarts_used == 0
 
+    def test_preemption_with_error_none_peers_counts_as_preemption(self):
+        """REGRESSION (ISSUE 7 satellite): gang-killed peer rows can
+        surface with error=None (a launcher that reports disposition
+        structurally, or an exit-code-only integration); the old
+        '"peer failure" in error' string match classified the clean
+        preemption as a budget-burning failure."""
+        rows = [
+            _preempted(0),
+            WorkerResult(index=1, ok=False, error=None, exit_code=None),
+        ]
+        launcher = FakeLauncher([rows, [_ok(0), _ok(1)]])
+        sup = Supervisor(["prog"], 2, launcher=launcher,
+                         policy=RestartPolicy(max_restarts=0),
+                         sleep=lambda s: None)
+        out = sup.run(timeout=5)
+        assert out.ok and out.preemptions == 1 and out.restarts_used == 0
+
+    def test_independent_fault_next_to_preemption_still_burns_budget(self):
+        """The flip side of the disposition fix: a peer that EXITED on its
+        own (it has an exit code) during a preemption is an independent
+        fault — the attempt must NOT classify as preemption."""
+        rows = [
+            _preempted(0),
+            WorkerResult(index=1, ok=False, error="exit code 17",
+                         exit_code=17, disposition="exited"),
+        ]
+        launcher = FakeLauncher([rows, [_ok(0), _ok(1)]])
+        sup = Supervisor(["prog"], 2, launcher=launcher,
+                         policy=RestartPolicy(max_restarts=1, backoff=0.0),
+                         sleep=lambda s: None)
+        out = sup.run(timeout=5)
+        assert out.ok and out.preemptions == 0 and out.restarts_used == 1
+
+    def test_events_carry_world_size_and_result_carries_resizes(
+            self, tmp_path):
+        """ISSUE 7 satellite: attempt_start/restart events name the
+        attempt's world size and SupervisedResult surfaces resize
+        accounting, so the JSONL log can attribute restarts to resizes."""
+        launcher = FakeLauncher([[_fail()], [_ok()]])
+        log = EventLog(tmp_path / "ev.jsonl")
+        sup = Supervisor(["prog"], 1, launcher=launcher,
+                         policy=RestartPolicy(max_restarts=1, backoff=0.0),
+                         event_log=log, sleep=lambda s: None)
+        out = sup.run(timeout=5)
+        assert out.ok and out.resizes == 0 and out.world_size == 1
+        events = log.read()
+        assert all(e["world_size"] == 1 for e in events
+                   if e["event"] in ("attempt_start", "attempt_end",
+                                     "restart", "run_complete"))
+        restart = next(e for e in events if e["event"] == "restart")
+        assert restart["resizes"] == 0
+
     def test_preemption_cap_bounds_the_loop(self):
         launcher = FakeLauncher([[_preempted()]] * 3)
         sup = Supervisor(["prog"], 1, launcher=launcher,

@@ -161,6 +161,110 @@ class TestShardedLifecycle:
             ck.restore_into(other, step=1)
 
 
+def _repartition(step_dir, nprocs):
+    """Rewrite a saved sharded checkpoint as if ``nprocs`` processes had
+    written it: round-robin the saved blocks across proc-0..N-1.npz and
+    patch the manifest — a faithful on-disk image of an N-process save
+    (restore never cares WHICH proc file holds a block, only that the
+    block index covers every leaf). The true process-count change is
+    exercised end-to-end by the elastic gang tests (tests/test_elastic.py
+    @slow: a 4-process-written checkpoint restored by a 2-process gang and
+    2->4); this helper lets tier-1 pin the multi-file block-index path
+    without spawning gangs."""
+    blocks = {}
+    for f in sorted(step_dir.glob("proc-*.npz")):
+        with np.load(f, allow_pickle=False) as z:
+            for k in z.files:
+                blocks[k] = z[k]
+        f.unlink()
+    keys = sorted(blocks)
+    for i in range(nprocs):
+        np.savez(step_dir / f"proc-{i}.npz",
+                 **{k: blocks[k] for k in keys[i::nprocs]})
+    manifest = json.loads((step_dir / "manifest.json").read_text())
+    manifest["nprocs"] = nprocs
+    (step_dir / "manifest.json").write_text(json.dumps(manifest))
+
+
+class TestElasticRestore:
+    """N->N' restore through the block index (ISSUE 7): checkpoints laid
+    out as 4- and 2-process saves restore into gangs of a different
+    world/strategy, optimizer state and the runtime-set
+    ``inject_hyperparams`` learning rate included."""
+
+    def _trained(self, strategy, tmp_path, lr=3.3e-4):
+        with strategy.scope():
+            m = dtpu.Model(dtpu.models.mnist_cnn())
+            m.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                      loss="sparse_categorical_crossentropy",
+                      metrics=["accuracy"])
+        x, y = _data(64)
+        m.fit(x, y, batch_size=32, epochs=1, verbose=0, seed=0)
+        m.set_learning_rate(lr)  # must survive the resized restore
+        ck = dtpu.ShardedCheckpointer(tmp_path)
+        ck.save(m)
+        return m, ck, (x, y)
+
+    def _assert_restored(self, m, m2, xy):
+        x, y = xy
+        assert m2.step == m.step
+        assert abs(m2.get_learning_rate() - m.get_learning_rate()) < 1e-9
+        for a, b in zip(jax.tree_util.tree_leaves(m.params),
+                        jax.tree_util.tree_leaves(m2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(m.opt_state),
+                        jax.tree_util.tree_leaves(m2.opt_state)):
+            np.testing.assert_array_equal(
+                np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)))
+        assert (m.evaluate(x, y, batch_size=32, verbose=0)
+                == m2.evaluate(x, y, batch_size=32, verbose=0))
+
+    def test_zero1_4proc_layout_restores_into_smaller_world(
+            self, devices, tmp_path):
+        """A ZeRO-1 checkpoint in 4-process layout restores under the live
+        (smaller-world) runtime: momentum comes back data-sharded from
+        blocks scattered over all four proc files, and training continues."""
+        m, ck, xy = self._trained(dtpu.ZeroDataParallel(), tmp_path)
+        _repartition(tmp_path / f"ckpt-{m.step}", 4)
+
+        with dtpu.ZeroDataParallel().scope():
+            m2 = dtpu.Model(dtpu.models.mnist_cnn())
+            m2.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                       loss="sparse_categorical_crossentropy",
+                       metrics=["accuracy"])
+        m2.build((28, 28, 1))
+        ck.restore_into(m2)
+        self._assert_restored(m, m2, xy)
+        x, y = xy
+        m2.fit(x, y, batch_size=32, epochs=1, steps_per_epoch=1, verbose=0,
+               seed=0, initial_epoch=0)
+        assert m2.step == m.step + 1
+
+    def test_fsdp_2proc_layout_restores_into_larger_world_and_strategy(
+            self, devices, tmp_path):
+        """The grow direction, composed with a strategy change: an FSDP
+        checkpoint in 2-process layout restores into a ZeRO-1 model — the
+        block index reassembles each leaf from both proc files under the
+        NEW strategy's placement."""
+        m, ck, xy = self._trained(dtpu.FullyShardedDataParallel(), tmp_path)
+        _repartition(tmp_path / f"ckpt-{m.step}", 2)
+
+        with dtpu.ZeroDataParallel().scope():
+            m2 = dtpu.Model(dtpu.models.mnist_cnn())
+            m2.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+                       loss="sparse_categorical_crossentropy",
+                       metrics=["accuracy"])
+        m2.build((28, 28, 1))
+        ck.restore_into(m2)
+        self._assert_restored(m, m2, xy)
+        # restored under the LIVE strategy: params replicated (ZeRO-1),
+        # not FSDP-sharded like the save
+        from jax.sharding import PartitionSpec
+
+        assert (m2.params["dense"]["kernel"].sharding.spec
+                == PartitionSpec())
+
+
 def test_model_checkpoint_callback_sharded(devices, tmp_path):
     """ModelCheckpoint(sharded=True) saves per-process files and a crash
     relaunch resumes from them."""
