@@ -52,6 +52,20 @@ from .training.history import History
 from .training.model import Model
 from .version import __version__
 
+
+def __getattr__(name):
+    # `dtpu.quant` resolves lazily rather than via an eager top-level
+    # import: the raw-speed tier (quant, and through optim.fused_adam /
+    # ops.fused_update the Pallas optimizer kernel) must never add to the
+    # base import cost on CPU boxes. quant itself is light (jnp only) and
+    # usually already bound by nn's layer imports; the Pallas machinery
+    # stays behind ops.__getattr__ until an API that needs it is called.
+    if name == "quant":
+        import importlib
+
+        return importlib.import_module(".quant", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "Model",
     "History",
@@ -88,5 +102,6 @@ __all__ = [
     "callbacks",
     "resilience",
     "serving",
+    "quant",  # lazy: see __getattr__
     "__version__",
 ]

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from . import initializers
 from .core import Layer, Shape
 from ..precision import resolve_dtype
+from ..quant import maybe_dequantize, shape_of
 
 
 class MultiHeadAttention(Layer):
@@ -189,7 +190,9 @@ class MultiHeadAttention(Layer):
         return shard_rows(fn, (q, k, v), (spec, spec, spec), spec)
 
     def _proj(self, params, x, w, b):
-        kernel = params[w]
+        # Weight-only int8 (quant.py): dequantize in-trace; compute dtype
+        # handling below is unchanged.
+        kernel = maybe_dequantize(params[w])
         dt = resolve_dtype(self.dtype)
         if dt is not None:
             kernel = kernel.astype(dt)
@@ -202,7 +205,7 @@ class MultiHeadAttention(Layer):
     decode_safe = True  # via the cached override below
 
     def init_cache(self, params, batch, max_len, dtype):
-        inner = params["wq"].shape[1]
+        inner = shape_of(params["wq"])[1]
         hd = inner // self.num_heads
         shape = (batch, max_len, self.num_heads, hd)
         cdtype = self.dtype or dtype
@@ -228,7 +231,7 @@ class MultiHeadAttention(Layer):
             x = x.astype(dt)
         b = x.shape[0]
         h = self.num_heads
-        hd = params["wq"].shape[1] // h
+        hd = shape_of(params["wq"])[1] // h
         q = self._proj(params, x, "wq", "bq").reshape(b, 1, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(b, 1, h, hd)
         v = self._proj(params, x, "wv", "bv").reshape(b, 1, h, hd)
@@ -248,7 +251,7 @@ class MultiHeadAttention(Layer):
         )
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, cv).reshape(b, 1, h * hd)
-        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)
         return out, {"k": ck, "v": cv}
@@ -265,7 +268,7 @@ class MultiHeadAttention(Layer):
     # (ROADMAP item 4).
 
     def init_paged_cache(self, params, num_blocks, block_size, dtype):
-        inner = params["wq"].shape[1]
+        inner = shape_of(params["wq"])[1]
         hd = inner // self.num_heads
         shape = (num_blocks, block_size, self.num_heads, hd)
         cdtype = self.dtype or dtype
@@ -301,7 +304,7 @@ class MultiHeadAttention(Layer):
             x = x.astype(dt)
         s = x.shape[0]
         h = self.num_heads
-        hd = params["wq"].shape[1] // h
+        hd = shape_of(params["wq"])[1] // h
         bs = cache["k"].shape[1]
         q = self._proj(params, x, "wq", "bq").reshape(s, 1, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(s, h, hd)
@@ -325,7 +328,7 @@ class MultiHeadAttention(Layer):
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, view_v).reshape(s, 1,
                                                                   h * hd)
-        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)
         return out, {"k": ck, "v": cv}
@@ -349,7 +352,7 @@ class MultiHeadAttention(Layer):
             x = x.astype(dt)
         c = x.shape[1]
         h = self.num_heads
-        hd = params["wq"].shape[1] // h
+        hd = shape_of(params["wq"])[1] // h
         bs = cache["k"].shape[1]
         q = self._proj(params, x, "wq", "bq").reshape(1, c, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(c, h, hd)
@@ -374,7 +377,7 @@ class MultiHeadAttention(Layer):
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         ctx = jnp.einsum("bhqk,khd->bqhd", attn, view_v).reshape(1, c,
                                                                  h * hd)
-        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)
         return out, {"k": ck, "v": cv}
@@ -385,7 +388,7 @@ class MultiHeadAttention(Layer):
             x = x.astype(dt)
         b, t, _ = x.shape
         h = self.num_heads
-        hd = params["wq"].shape[1] // h  # robust if apply runs on a fresh instance
+        hd = shape_of(params["wq"])[1] // h  # robust if apply runs on a fresh instance
         q = self._proj(params, x, "wq", "bq").reshape(b, t, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(b, t, h, hd)
         v = self._proj(params, x, "wv", "bv").reshape(b, t, h, hd)
@@ -411,7 +414,7 @@ class MultiHeadAttention(Layer):
 
             ctx = dense_attention(q, k, v, self.causal)
         ctx = ctx.reshape(b, t, h * hd)
-        out = jnp.dot(ctx, params["wo"].astype(ctx.dtype))
+        out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)
         return out, {}
@@ -435,7 +438,8 @@ class PositionalEmbedding(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         t = x.shape[1]
-        return x + params["table"][:t][None].astype(x.dtype), {}
+        table = maybe_dequantize(params["table"])
+        return x + table[:t][None].astype(x.dtype), {}
 
     decode_safe = True  # positional rows picked by ``pos``, not x.shape
 
@@ -449,7 +453,7 @@ class PositionalEmbedding(Layer):
 
     def decode(self, params, state, cache, x, *, pos):
         row = jax.lax.dynamic_slice_in_dim(
-            params["table"], pos, 1, axis=0
+            maybe_dequantize(params["table"]), pos, 1, axis=0
         )  # (1, D)
         return x + row[None].astype(x.dtype), cache
 
@@ -457,12 +461,14 @@ class PositionalEmbedding(Layer):
                      positions):
         # Per-SLOT positions: slot s reads table row positions[s] — the
         # vectorized form of decode()'s single dynamic row.
-        rows = jnp.take(params["table"], positions, axis=0)  # (S, D)
+        rows = jnp.take(
+            maybe_dequantize(params["table"]), positions, axis=0
+        )  # (S, D)
         return x + rows[:, None].astype(x.dtype), cache
 
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
         c = x.shape[1]
         rows = jax.lax.dynamic_slice_in_dim(
-            params["table"], start, c, axis=0
+            maybe_dequantize(params["table"]), start, c, axis=0
         )  # (C, D)
         return x + rows[None].astype(x.dtype), cache

@@ -25,6 +25,7 @@ from jax import lax
 from . import activations, initializers
 from .core import Layer, Shape
 from ..precision import resolve_dtype
+from ..quant import is_quantized_leaf, maybe_dequantize
 
 IntOr2 = Union[int, Tuple[int, int]]
 
@@ -86,7 +87,9 @@ class Conv2D(Layer):
         return params, {}, out
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        kernel = params["kernel"]
+        # Weight-only int8 (quant.py): dequantize in-trace, then the
+        # layer's own dtype handling applies as if the kernel were f32.
+        kernel = maybe_dequantize(params["kernel"])
         dt = resolve_dtype(self.dtype)
         if dt is not None:
             x = x.astype(dt)
@@ -149,7 +152,9 @@ class Dense(Layer):
         return params, {}, tuple(input_shape[:-1]) + (self.units,)
 
     def apply(self, params, state, x, *, train=False, rng=None):
-        kernel = params["kernel"]
+        # Weight-only int8 (quant.py): dequantize in-trace before the
+        # matmul; storage stays int8 in HBM, compute dtype is unchanged.
+        kernel = maybe_dequantize(params["kernel"])
         dt = resolve_dtype(self.dtype)
         if dt is not None:
             x = x.astype(dt)
@@ -498,6 +503,13 @@ class Embedding(Layer):
     def apply(self, params, state, x, *, train=False, rng=None):
         table = params["table"]
         dt = resolve_dtype(self.dtype)
+        if is_quantized_leaf(table):
+            # Gather int8 rows FIRST, dequantize only the gathered rows
+            # (per-channel scales broadcast over the trailing dim) — the
+            # full f32 table never materializes on the decode path.
+            rows = jnp.take(table["q"], x, axis=0).astype(jnp.float32)
+            rows = rows * table["scale"]
+            return rows if dt is None else rows.astype(dt), {}
         if dt is not None:
             table = table.astype(dt)
         return jnp.take(table, x, axis=0), {}

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from . import core, initializers
 from .core import Layer, Shape
+from ..quant import maybe_dequantize
 from ..precision import resolve_dtype
 
 
@@ -176,7 +177,7 @@ class MoE(Layer):
         # Router probs + top-k choice + renormalized gates (shared with
         # decode()). probs: (G, g, e); gate_vals/gate_idx: (G, g, k).
         probs, gate_vals, gate_idx = self._route(
-            tokens.astype(jnp.float32), params["router"]
+            tokens.astype(jnp.float32), maybe_dequantize(params["router"])
         )
 
         # Position of each (token, choice) in its expert's per-group buffer;
@@ -208,12 +209,12 @@ class MoE(Layer):
         )
         hid = act(
             jnp.einsum("Gecd,edh->Gech", buf,
-                       params["w_in"].astype(compute_dtype))
+                       maybe_dequantize(params["w_in"]).astype(compute_dtype))
             + params["b_in"][None, :, None].astype(compute_dtype)
         )
         out_buf = (
             jnp.einsum("Gech,ehd->Gecd", hid,
-                       params["w_out"].astype(compute_dtype))
+                       maybe_dequantize(params["w_out"]).astype(compute_dtype))
             + params["b_out"][None, :, None].astype(compute_dtype)
         )
         out = jnp.einsum(
@@ -251,7 +252,7 @@ class MoE(Layer):
         e, k = self.num_experts, self.top_k
         flat = x.reshape(b * t, d)
         _, gate_vals, gate_idx = self._route(
-            flat.astype(jnp.float32), params["router"]
+            flat.astype(jnp.float32), maybe_dequantize(params["router"])
         )  # (N, k)
         # Per-expert combine weight: sum of the gates that chose it.
         weight = jnp.einsum(
@@ -261,12 +262,12 @@ class MoE(Layer):
         compute_dtype = resolve_dtype(self.dtype) or x.dtype
         h = act(
             jnp.einsum("nd,edh->neh", flat.astype(compute_dtype),
-                       params["w_in"].astype(compute_dtype))
+                       maybe_dequantize(params["w_in"]).astype(compute_dtype))
             + params["b_in"][None].astype(compute_dtype)
         )
         out_e = (
             jnp.einsum("neh,ehd->ned", h,
-                       params["w_out"].astype(compute_dtype))
+                       maybe_dequantize(params["w_out"]).astype(compute_dtype))
             + params["b_out"][None].astype(compute_dtype)
         )
         out = jnp.einsum(

@@ -7,7 +7,12 @@ from . import losses, metrics
 # depending on import order (importing the submodule binds it on this
 # package, silently replacing a re-exported function). Call sites use
 # ops.flash_attention.flash_attention / ops.ring_attention.ring_attention.
-_LAZY_SUBMODULES = ("flash_attention", "ring_attention", "pallas_kernels")
+_LAZY_SUBMODULES = (
+    "flash_attention",
+    "ring_attention",
+    "pallas_kernels",
+    "fused_update",
+)
 
 __all__ = ["losses", "metrics", *_LAZY_SUBMODULES]
 

@@ -1,0 +1,30 @@
+"""Helpers shared by every Pallas kernel module in ``ops``.
+
+``flash_attention``, ``pallas_kernels`` and ``fused_update`` all need the
+same two decisions — *where* a kernel runs (Mosaic on real TPUs,
+interpreter everywhere else) and *how* shapes are padded to tile
+boundaries. Both used to be copy-pasted per module; this is the single
+definition (ring_attention builds on shard_map/ppermute, not pallas_call,
+so it has nothing to consolidate here).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret() -> bool:
+    """True when pallas_call must run in interpreter mode: Mosaic lowering
+    exists only for real TPUs; everywhere else (CPU CI, the 8-device sim)
+    the interpreter runs the same kernel semantics."""
+    return jax.default_backend() != "tpu"
+
+
+def round_up(v: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``v``."""
+    return -(-v // m) * m
+
+
+# Column/score padding value shared by the attention-family kernels:
+# exp(NEG - max) == 0, and NEG is large enough to never be the row max.
+NEG = -1e30

@@ -36,15 +36,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _round_up(v: int, m: int) -> int:
-    return -(-v // m) * m
-
-
-_NEG = -1e30
+from ._pallas_common import (
+    NEG as _NEG,
+    interpret as _interpret,
+    round_up as _round_up,
+)
 
 
 # -------------------------------------------------- shared kernel helpers --

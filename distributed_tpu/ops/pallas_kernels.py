@@ -28,15 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-
-def _interpret() -> bool:
-    # Mosaic lowering exists only for real TPUs; everywhere else (CPU CI,
-    # the 8-device sim) the interpreter runs the same kernel semantics.
-    return jax.default_backend() != "tpu"
-
-
-def _round_up(v: int, m: int) -> int:
-    return -(-v // m) * m
+from ._pallas_common import interpret as _interpret, round_up as _round_up
 
 
 # --------------------------------------------------------------- kernels --
@@ -64,7 +56,8 @@ def _xent_bwd_kernel(logits_ref, labels_ref, g_ref, dlogits_ref):
 
 
 # --------------------------------------------------------------- wrappers --
-_NEG = -1e30  # column padding: exp(_NEG - max) == 0, never the row max
+# Column padding: exp(NEG - max) == 0, never the row max (shared constant).
+from ._pallas_common import NEG as _NEG  # noqa: E402
 
 
 def _pad_inputs(logits, labels, bm):

@@ -43,6 +43,36 @@ def AdamW(learning_rate: float = 0.001, weight_decay: float = 0.01, b1=0.9, b2=0
     )
 
 
+def fused_adam(learning_rate: float = 0.001, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8):
+    """Adam whose whole update — moment EMAs, bias correction, step — runs
+    as ONE Pallas kernel pass per same-dtype flat segment of the master
+    tree, instead of the stock per-leaf tree walk (ops.fused_update; the
+    raw-speed lever measured by ``bench.py fused_update``). Numerically
+    operation-for-operation identical to ``Adam``; drops into the same
+    ``Strategy.init_opt_state``/``constrain_step`` seams (the moment trees
+    shard exactly like stock Adam state under ZeRO-1/FSDP), and the
+    ``inject_hyperparams`` wrapper keeps the learning rate runtime-mutable
+    and checkpointable. CPU backends run the kernel in interpret mode
+    (same semantics, no speedup — see docs/PERF.md)."""
+    from ..ops import fused_update  # lazy: pulls in pallas
+
+    return optax.inject_hyperparams(fused_update.fused_adam)(
+        learning_rate, b1=b1, b2=b2, eps=eps
+    )
+
+
+def fused_adamw(learning_rate: float = 0.001, weight_decay: float = 0.01,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """AdamW spelling of :func:`fused_adam` — the decoupled weight decay
+    folds into the same single kernel pass."""
+    from ..ops import fused_update  # lazy: pulls in pallas
+
+    return optax.inject_hyperparams(fused_update.fused_adam)(
+        learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay
+    )
+
+
 def RMSprop(learning_rate: float = 0.001, decay: float = 0.9,
             momentum: float = 0.0, eps: float = 1e-7):
     return optax.inject_hyperparams(optax.rmsprop)(
@@ -234,6 +264,8 @@ _REGISTRY = {
     "sgd": SGD,
     "adam": Adam,
     "adamw": AdamW,
+    "fused_adam": fused_adam,
+    "fused_adamw": fused_adamw,
     "rmsprop": RMSprop,
     "adagrad": Adagrad,
     "lamb": Lamb,

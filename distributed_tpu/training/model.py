@@ -928,6 +928,15 @@ class Model:
         lands in ``model.last_fit_telemetry``."""
         if not self.compiled:
             raise RuntimeError("Call compile() before fit()")
+        from .. import quant as quant_lib
+
+        if self.built and quant_lib.is_quantized(self.params):
+            raise RuntimeError(
+                "model parameters are int8-quantized (quant.quantize_model)"
+                " — quantized weights carry no gradients, so fit() is "
+                "unavailable. Serve with generate()/predict()/serving."
+                "Engine, or restore the f32 checkpoint to keep training."
+            )
         if y is None:
             # Iterator mode: x yields (x_batch, y_batch) — e.g. a
             # dtpu.data.Pipeline whose native threads prefetch batches ahead

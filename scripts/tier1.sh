@@ -54,6 +54,17 @@ if [ -n "${TIER1_SERVE_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_QUANT_SMOKE=1: same idea for the raw-speed tier — runs ONLY the
+# int8-quantization + fused-optimizer tests and their bench smokes
+# (~60 s) so quant/kernel changes iterate fast. NOT a tier-1 substitute.
+if [ -n "${TIER1_QUANT_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py \
+        tests/test_fused_update.py \
+        "tests/test_bench.py::test_bench_quant_smoke" \
+        "tests/test_bench.py::test_bench_fused_update_smoke" \
+        -q --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 # TIER1_ELASTIC_SMOKE=1: same idea for the elastic-gang subsystem — runs
 # the elastic policy/supervisor/cluster/pipeline units plus the N->N'
 # sharded-restore tests (~15 s). The real-gang shrink/grow fault matrix

@@ -129,6 +129,48 @@ def test_bench_serve_smoke():
     assert out["workload"]["useful_tokens"] > 0
 
 
+def test_bench_quant_smoke():
+    """The quant mode at tiny shapes: exercises the full path — build,
+    quantize-on-load, byte accounting, decode-fidelity probes, the FSDP
+    gather estimate — and the artifact schema. The BYTE-RATIO and 2x
+    gates are asserted only by the real `python bench.py quant`
+    (BENCH_quant.json) on the l4 d256 shape; at d=32 the f32-kept 1-D
+    leaves dilute them (recorded, not gated)."""
+    out = bench.bench_quant(
+        vocab=32, num_layers=1, d_model=32, num_heads=2, max_len=64,
+        probe_batch=2, probe_len=8,
+    )
+    assert out["unit"] == "x_fewer_param_bytes_per_device"
+    assert out["value"] > 2.0
+    assert out["param_bytes_per_device"]["int8"] < \
+        out["param_bytes_per_device"]["f32"]
+    fid = out["decode_fidelity"]
+    assert 0.0 <= fid["top1_agreement"] <= 1.0
+    assert fid["max_abs_logit_err"] >= 0.0
+    if "fsdp_gathered_bytes_per_device" in out:  # multi-device run
+        g = out["fsdp_gathered_bytes_per_device"]
+        assert g["int8"] < g["bf16"] < g["f32"]
+        assert out["fsdp_gather_ratio_bf16_over_int8"]["weight_leaves"] \
+            == pytest.approx(2.0)
+
+
+def test_bench_fused_update_smoke():
+    """The fused_update mode at tiny shapes: schema + the mechanism
+    fields. No speedup assertion on CPU — the kernel runs in Pallas
+    interpret mode there (the artifact records that honestly)."""
+    out = bench.bench_fused_update(
+        vocab=32, num_layers=1, d_model=32, num_heads=2, max_len=64,
+        updates=2, windows=1,
+    )
+    assert out["unit"] == "x_vs_stock_optax_update_phase"
+    assert out["update_phase_ms"]["stock_adam"] > 0
+    assert out["update_phase_ms"]["fused_adam"] > 0
+    assert out["backend"] == "cpu" and out["speedup_asserted"] is False
+    mech = out["mechanism"]
+    assert mech["parity_max_abs_diff_after_updates"] < 1e-5
+    assert mech["n_param_leaves"] > mech["n_segments"] == 1
+
+
 def test_bench_output_contract(monkeypatch, capsys):
     """main() prints exactly one JSON line with the driver's schema."""
     monkeypatch.setattr(

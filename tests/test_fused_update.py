@@ -1,0 +1,208 @@
+"""Fused Adam/AdamW Pallas kernel (ops.fused_update / optim.fused_adam).
+
+The contract under test: the fused update is operation-for-operation the
+stock optax math, so trajectories match bit-for-bit on a single device and
+to float-noise (FMA regrouping inside shard_map) on a mesh — the ISSUE's
+"bit-compared (or rtol <= 1e-6) against stock optax Adam over 10 steps
+under SingleDevice/DP/ZeRO-1/FSDP".
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.ops.fused_update import FusedAdamState
+
+STRATEGIES = {
+    "single": dtpu.SingleDevice,
+    "dp": dtpu.DataParallel,
+    "zero1": dtpu.ZeroDataParallel,
+    "fsdp": dtpu.FSDP,
+}
+
+
+def _tree_diff(a, b):
+    return max(
+        float(np.max(np.abs(
+            np.asarray(x, np.float64) - np.asarray(y, np.float64))))
+        if np.asarray(x).size else 0.0
+        for x, y in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                        jax.tree_util.tree_leaves(jax.device_get(b)))
+    )
+
+
+def _assert_tree_close(a, b, rtol=1e-6, atol=1e-7):
+    for x, y in zip(jax.tree_util.tree_leaves(jax.device_get(a)),
+                    jax.tree_util.tree_leaves(jax.device_get(b))):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float64), np.asarray(y, np.float64),
+            rtol=rtol, atol=atol,
+        )
+
+
+# ------------------------------------------------------- transform level --
+def _run_transform(tx, strategy, params, n_steps=10):
+    opt_state = strategy.init_opt_state(tx, params)
+
+    @jax.jit
+    def one(p, s, g):
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    key = jax.random.PRNGKey(1)
+    p = params
+    with strategy.scope():
+        for i in range(n_steps):
+            g = jax.tree_util.tree_map(
+                lambda a: jax.random.normal(
+                    jax.random.fold_in(key, i), a.shape, a.dtype),
+                params,
+            )
+            p, opt_state = one(p, opt_state, g)
+    return p, opt_state
+
+
+@pytest.mark.parametrize("name", sorted(STRATEGIES))
+def test_transform_matches_stock_adam(name):
+    strategy = STRATEGIES[name]()
+    with strategy.scope():
+        key = jax.random.PRNGKey(0)
+        params = strategy.put_params({
+            "w": jax.random.normal(key, (64, 32)),
+            "nest": {"k": jax.random.normal(key, (16, 8)),
+                     "b": jnp.zeros((8,))},
+        })
+    p_stock, _ = _run_transform(dtpu.optim.Adam(1e-2), strategy, params)
+    p_fused, _ = _run_transform(dtpu.optim.fused_adam(1e-2), strategy,
+                                params)
+    if name == "single":
+        assert _tree_diff(p_stock, p_fused) == 0.0  # bit-identical
+    else:
+        # On a mesh the fused path runs under shard_map; XLA may contract
+        # multiply-adds differently there — ulp-level, far inside the
+        # acceptance rtol.
+        _assert_tree_close(p_stock, p_fused)
+
+
+def test_transform_matches_stock_adamw():
+    strategy = dtpu.SingleDevice()
+    with strategy.scope():
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (32, 16))}
+    p_stock, _ = _run_transform(
+        dtpu.optim.AdamW(1e-2, weight_decay=0.05), strategy, params)
+    p_fused, _ = _run_transform(
+        dtpu.optim.fused_adamw(1e-2, weight_decay=0.05), strategy, params)
+    assert _tree_diff(p_stock, p_fused) == 0.0
+
+
+def test_integer_leaves_pass_through():
+    # Base factory, not the inject_hyperparams wrapper: inject (stock
+    # optax behavior, fused and stock Adam alike) canonicalizes the
+    # injected scalars to the first leaf's dtype, so an int-first tree is
+    # its known pathology, not this kernel's.
+    from distributed_tpu.ops import fused_update as fu
+
+    tx = fu.fused_adam(1e-2)
+    params = {"w": jnp.ones((8, 8)), "step_buf": jnp.arange(4)}
+    state = tx.init(params)
+    grads = {"w": jnp.ones((8, 8)), "step_buf": jnp.zeros(4, jnp.int32)}
+    updates, _ = tx.update(grads, state, params)
+    assert np.all(np.asarray(updates["step_buf"]) == 0)
+    assert np.any(np.asarray(updates["w"]) != 0)
+
+
+# ----------------------------------------------------------- model level --
+def _fit_params(opt, strategy_cls, seed=0):
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(64, 16)).astype(np.float32)
+    y = rng.integers(0, 8, (64,)).astype(np.int32)
+    strategy = strategy_cls()
+    with strategy.scope():
+        m = dtpu.Model(dtpu.nn.Sequential([
+            dtpu.nn.Dense(32, activation="relu"), dtpu.nn.Dense(8)
+        ]))
+        m.compile(optimizer=opt, loss="sparse_categorical_crossentropy")
+    m.build((16,), seed=seed)
+    h = m.fit(x, y, batch_size=16, epochs=1, steps_per_epoch=10, verbose=0,
+              shuffle=False, prefetch=0)
+    return m, h.history["loss"][-1]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(STRATEGIES))
+def test_model_10step_parity(name):
+    """Full fit()-path parity sweep — @slow: the tier-1 acceptance check
+    is the transform-level 10-step comparison above (all 4 strategies)
+    plus the LM bit-parity below; this end-to-end sweep re-proves the
+    same numbers through fit() and rides the slow lane."""
+    m_stock, l_stock = _fit_params(dtpu.optim.Adam(1e-3), STRATEGIES[name])
+    m_fused, l_fused = _fit_params(
+        dtpu.optim.fused_adam(1e-3), STRATEGIES[name])
+    assert l_fused == pytest.approx(l_stock, rel=1e-6)
+    _assert_tree_close(m_stock.params, m_fused.params)
+
+
+def test_lm_singledevice_bit_parity():
+    """Attention LM, fused vs stock, SingleDevice: bit-identical — the
+    kernel's math exactly reproduces optax's per-leaf chain."""
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, 64, (32, 17), dtype=np.int64)
+    x, y = tok[:, :-1].astype(np.int32), tok[:, 1:].astype(np.int32)
+
+    def run(opt):
+        m = dtpu.Model(dtpu.models.transformer_lm(
+            64, num_layers=1, d_model=32, num_heads=2, max_len=16))
+        m.compile(optimizer=opt, loss="sparse_categorical_crossentropy")
+        m.build((16,), seed=0)
+        m.fit(x, y, batch_size=16, epochs=1, steps_per_epoch=4, verbose=0,
+              shuffle=False, prefetch=0)
+        return m.params
+
+    assert _tree_diff(run(dtpu.optim.Adam(1e-3)),
+                      run(dtpu.optim.fused_adam(1e-3))) == 0.0
+
+
+# ----------------------------------------------- hyperparams + registry --
+def test_learning_rate_mutation_and_registry():
+    m, _ = _fit_params("fused_adam", dtpu.SingleDevice)  # registry name
+    m.set_learning_rate(5e-4)
+    assert m.get_learning_rate() == pytest.approx(5e-4)
+    # state really is the fused kernel's (not silently stock adam)
+    assert any(
+        isinstance(s, FusedAdamState)
+        for s in jax.tree_util.tree_leaves(
+            m.opt_state, is_leaf=lambda x: isinstance(x, FusedAdamState))
+    )
+
+
+def test_checkpoint_roundtrip_fused_state(tmp_path):
+    """Fused-Adam opt state (count + moments + injected LR) survives
+    Checkpointer save/restore exactly, including a runtime-mutated LR."""
+    m, _ = _fit_params(dtpu.optim.fused_adam(1e-3), dtpu.SingleDevice)
+    m.set_learning_rate(2.5e-4)
+    ckpt = dtpu.Checkpointer(tmp_path / "ck")
+    ckpt.save(m, step=m.step)
+
+    m2, _ = _fit_params(dtpu.optim.fused_adam(1e-3), dtpu.SingleDevice)
+    ckpt.restore_into(m2)
+    assert m2.get_learning_rate() == pytest.approx(2.5e-4)
+    assert _tree_diff(m.opt_state, m2.opt_state) == 0.0
+    assert _tree_diff(m.params, m2.params) == 0.0
+
+
+def test_sharded_checkpoint_roundtrip_fused_state(tmp_path):
+    """Same round-trip through ShardedCheckpointer under ZeRO-1 (the
+    fused moments are data-sharded on disk and back)."""
+    m, _ = _fit_params(dtpu.optim.fused_adam(1e-3), dtpu.ZeroDataParallel)
+    m.set_learning_rate(1.25e-4)
+    ckpt = dtpu.ShardedCheckpointer(tmp_path / "sck")
+    ckpt.save(m, step=m.step)
+
+    m2, _ = _fit_params(dtpu.optim.fused_adam(1e-3), dtpu.ZeroDataParallel)
+    ckpt.restore_into(m2)
+    assert m2.get_learning_rate() == pytest.approx(1.25e-4)
+    assert _tree_diff(m.opt_state, m2.opt_state) == 0.0
+    assert _tree_diff(m.params, m2.params) == 0.0

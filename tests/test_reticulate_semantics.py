@@ -300,7 +300,12 @@ def test_resnet_and_cifar_constructors(rb):
     c._obj.build((32, 32, 3))
 
 
+@pytest.mark.slow
 def test_other_dataset_loaders(rb):
+    # @slow (tier-1 budget triage, the PR 6 whale precedent): 18s of
+    # dataset synthesis to check two loaders whose Python side is covered
+    # by test_datasets.py and whose R marshaling is exercised by the
+    # mnist loader tests above.
     for d in (rb.dataset_fashion_mnist(), rb.dataset_cifar10()):
         x = d.get("train").get("x")
         assert isinstance(x, RArray) and x.array.ndim == 4
