@@ -1,3 +1,4 @@
+from .auto_shard import Candidate, Feasibility, Plan, plan_sharding
 from .mesh import AXES, batch_sharding, make_mesh, replicated
 from .strategy import (
     CompositeParallel,
@@ -16,6 +17,10 @@ from .strategy import (
 
 __all__ = [
     "AXES",
+    "Candidate",
+    "Feasibility",
+    "Plan",
+    "plan_sharding",
     "make_mesh",
     "replicated",
     "batch_sharding",

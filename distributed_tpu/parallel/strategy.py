@@ -70,6 +70,25 @@ def _largest_divisible_spec(shape, n: int, axis: str,
     return PartitionSpec(*spec)
 
 
+def _path_key(entry) -> str:
+    """Stable name of one tree-path entry (DictKey / SequenceKey /
+    GetAttrKey / FlattenedIndexKey all stringify distinctly)."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _params_sharding_tree(strategy, params, hints=None):
+    """``strategy.params_sharding(params[, hints])`` across the two
+    signatures in this module (the base/DP family takes no hints; the
+    hinted family does). Shared by opt_state_sharding and the planner."""
+    try:
+        return strategy.params_sharding(params, hints)
+    except TypeError:
+        return strategy.params_sharding(params)
+
+
 class Strategy:
     """Base strategy: knows the mesh and how to place params and batches."""
 
@@ -130,11 +149,16 @@ class Strategy:
         nothing)."""
         return params
 
-    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+    def comm_bytes_estimate(self, params, compute_dtype=None,
+                            hints=None) -> dict:
         """Analytic per-step, per-device collective-traffic estimate for
         the parameter-sized collectives this strategy emits, at the dtype
         the bytes actually move in (``compute_dtype`` under a mixed-
-        precision policy, else the leaves' own dtype). Keys:
+        precision policy, else the leaves' own dtype — int8 weight-only
+        leaves (quant.py) keep their 1-byte dtype under EVERY strategy).
+        The schema is UNIFIED across SingleDevice/DP/ZeRO-1/FSDP/TP
+        (zeros where a collective doesn't apply) so the auto-shard
+        planner can compare rows apples-to-apples. Keys:
 
         - ``gathered_param_bytes_per_device``: one full gather of the
           strategy's sharded parameter state per step (FSDP: the per-layer
@@ -143,16 +167,72 @@ class Strategy:
           post-update all-gather of the parameter updates, at MASTER dtype
           — the update applies to f32 params).
         - ``grad_reduce_bytes_per_device``: the gradient all-reduce /
-          reduce-scatter, one param-tree's worth of bytes.
+          reduce-scatter, one param-tree's worth of bytes (of the bytes
+          this device HOLDS — a TP-sharded leaf reduces shard-sized
+          pieces).
+        - ``activation_reduce_bytes_per_token_per_device``: Megatron-style
+          per-layer activation all-reduces, PER TOKEN (they scale with the
+          batch the params estimate can't see; multiply by the step's
+          local token count). Non-zero only for tensor-parallel
+          strategies, which need ``hints`` (the module's sharding-role
+          tree) to know which matmuls are sharded.
 
-        An estimate, not a measurement (ring-collective (N-1)/N factors
-        and XLA fusion are ignored): its job is to make the MIXED vs f32
-        traffic ratio visible in telemetry/bench, which those constant
-        factors cancel out of. Base strategy emits no collectives."""
+        ``params`` may be a live tree or abstract ``ShapeDtypeStruct``
+        leaves (the planner's dry-run path). An estimate, not a
+        measurement (ring-collective (N-1)/N factors and XLA fusion are
+        ignored): its job is to make traffic RATIOS across configs/dtypes
+        visible in telemetry/bench/planner, which those constant factors
+        cancel out of. Base strategy emits no collectives."""
+        return self._comm_row()
+
+    @staticmethod
+    def _comm_row(gathered=0, grad=0, act_per_token=0) -> dict:
+        """The unified comm_bytes_estimate schema — one constructor so
+        strategies cannot drift keys."""
         return {
-            "gathered_param_bytes_per_device": 0,
-            "grad_reduce_bytes_per_device": 0,
+            "gathered_param_bytes_per_device": int(gathered),
+            "grad_reduce_bytes_per_device": int(grad),
+            "activation_reduce_bytes_per_token_per_device": int(
+                act_per_token
+            ),
         }
+
+    def opt_state_sharding(self, opt_state, params, hints=None):
+        """Sharding tree for an optimizer-state pytree, mirroring what
+        ``init_opt_state`` produces EAGERLY — but computable on abstract
+        ``ShapeDtypeStruct`` trees (the auto-shard planner prices
+        optimizer memory without materializing it). Default rule matches
+        the eager inherit-from-params behavior: an optimizer stat whose
+        tree-path tail + shape matches a parameter (Adam's mu/nu, SGD
+        momentum — optax stats mirror the params nesting) gets that
+        parameter's sharding; everything else (step counters, injected
+        hyperparams) replicates. Strategies with bespoke optimizer
+        placement (ZeRO-1's largest-divisible-dim shards) override."""
+        psh = _params_sharding_tree(self, params, hints)
+        if psh is None:
+            return jax.tree_util.tree_map(lambda _: None, opt_state)
+        rep = (
+            NamedSharding(self.mesh, PartitionSpec())
+            if self.mesh is not None else None
+        )
+        index = {}
+        param_leaves = jax.tree_util.tree_leaves_with_path(params)
+        for (path, leaf), sh in zip(
+            param_leaves, jax.tree_util.tree_leaves(psh)
+        ):
+            names = tuple(_path_key(k) for k in path)
+            index[(names, tuple(leaf.shape))] = sh
+
+        def place(path, leaf):
+            names = tuple(_path_key(k) for k in path)
+            shape = tuple(getattr(leaf, "shape", ()))
+            for i in range(len(names)):
+                hit = index.get((names[i:], shape))
+                if hit is not None:
+                    return hit
+            return rep
+
+        return jax.tree_util.tree_map_with_path(place, opt_state)
 
     @staticmethod
     def _leaf_comm_bytes(leaf, compute_dtype=None) -> int:
@@ -302,18 +382,17 @@ class DataParallel(Strategy):
             )
         return global_batch // n
 
-    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+    def comm_bytes_estimate(self, params, compute_dtype=None,
+                            hints=None) -> dict:
         # Replicated DP: one gradient all-reduce of the full param tree per
         # step; the cotangents it moves are compute-dtype under a mixed
-        # policy (the f32 cast-back to masters happens per device).
+        # policy (the f32 cast-back to masters happens per device). Int8
+        # weight-only leaves keep their 1-byte dtype (_leaf_comm_bytes).
         grad = sum(
             self._leaf_comm_bytes(l, compute_dtype)
             for l in jax.tree_util.tree_leaves(params)
         )
-        return {
-            "gathered_param_bytes_per_device": 0,
-            "grad_reduce_bytes_per_device": grad,
-        }
+        return self._comm_row(grad=grad)
 
 
 class ZeroDataParallel(DataParallel):
@@ -371,18 +450,32 @@ class ZeroDataParallel(DataParallel):
         )
         return params, opt_state
 
-    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+    def comm_bytes_estimate(self, params, compute_dtype=None,
+                            hints=None) -> dict:
         # DP's gradient all-reduce (compute-dtype bytes under a mixed
         # policy) plus ZeRO-1's post-update all-gather of the parameter
         # updates — which applies to the f32 MASTERS, so those bytes do
-        # NOT shrink under a reduced compute dtype.
-        out = super().comm_bytes_estimate(params, compute_dtype)
+        # NOT shrink under a reduced compute dtype (int8 leaves still
+        # price at their own 1-byte dtype).
+        out = super().comm_bytes_estimate(params, compute_dtype, hints)
         out["gathered_param_bytes_per_device"] = sum(
             self._leaf_comm_bytes(l, None)
             for l in jax.tree_util.tree_leaves(params)
             if self._shardable(l) and self._opt_spec(l.shape) != PartitionSpec()
         )
         return out
+
+    def opt_state_sharding(self, opt_state, params, hints=None):
+        # Mirrors init_opt_state: every ndim>=1 stat shards on its largest
+        # divisible dim; scalars replicate.
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def place(a):
+            if not self._shardable(a):
+                return rep
+            return NamedSharding(self.mesh, self._opt_spec(a.shape))
+
+        return jax.tree_util.tree_map(place, opt_state)
 
 
 def _check_pipe_divisible(params, hints, n: int, axis_name: str):
@@ -559,6 +652,54 @@ class DataTensorParallel(_HintedParallel):
             return PartitionSpec(*([None, m] + [None] * (ndim - 2)))
         return PartitionSpec()
 
+    def comm_bytes_estimate(self, params, compute_dtype=None,
+                            hints=None) -> dict:
+        """Megatron TP traffic. Gradient all-reduce over 'data' moves the
+        bytes each device HOLDS: full leaves for replicated params, a
+        1/model_parallel shard for col/row-hinted ones (without ``hints``
+        the estimate degenerates to DP's — it cannot know which leaves
+        are sharded). The per-layer activation collectives Megatron adds
+        (forward all-reduce after each row-parallel matmul, its mirror in
+        backward) scale with the token count, so they are priced PER
+        TOKEN: 2 x width-of-each-row-output x compute itemsize — the
+        planner multiplies by the step's local tokens. Sharded matmuls
+        never gather their weights, so the gathered key stays 0."""
+        import jax.numpy as jnp
+
+        tp = int(self.mesh.shape[self.model_axis])
+        data = int(self.mesh.shape[self.axis])
+        grad = 0
+        act_per_token = 0
+
+        def walk(p, h):
+            nonlocal grad, act_per_token
+            if isinstance(p, dict):
+                for k, v in p.items():
+                    walk(v, h.get(k, {}) if isinstance(h, dict) else h)
+                return
+            role = h if isinstance(h, str) else None
+            nbytes = self._leaf_comm_bytes(p, compute_dtype)
+            sharded = (
+                tp > 1
+                and self._role_spec(role, p.shape) != PartitionSpec()
+            )
+            if data > 1:
+                grad += nbytes // tp if sharded else nbytes
+            if role in ("row", "row1") and tp > 1:
+                # Row-parallel output width (last dim; 'row1' stacks
+                # shape[0] blocks of it): one fwd + one bwd all-reduce of
+                # (tokens, width) activations per block, at compute dtype.
+                itemsize = jnp.dtype(
+                    compute_dtype
+                    if compute_dtype is not None else jnp.result_type(p)
+                ).itemsize
+                width = int(p.shape[-1])
+                stack = int(p.shape[0]) if role == "row1" else 1
+                act_per_token += 2 * stack * width * itemsize
+
+        walk(params, hints or {})
+        return self._comm_row(grad=grad, act_per_token=act_per_token)
+
 
 class DataExpertParallel(_HintedParallel):
     """Expert parallelism composed with data parallelism: MoE expert stacks
@@ -673,7 +814,8 @@ class FullyShardedDataParallel(_HintedParallel):
 
         return jax.tree_util.tree_map(pin, params)
 
-    def comm_bytes_estimate(self, params, compute_dtype=None) -> dict:
+    def comm_bytes_estimate(self, params, compute_dtype=None,
+                            hints=None) -> dict:
         # ZeRO-3: every sharded parameter is all-gathered before use (one
         # full gather counted; the backward re-gather doubles it in
         # practice) and the gradients reduce-scatter back — both at
@@ -688,13 +830,23 @@ class FullyShardedDataParallel(_HintedParallel):
             if getattr(l, "ndim", 0) >= 1
             and self._spec_for(l.shape) != PartitionSpec()
         )
-        return {
-            "gathered_param_bytes_per_device": gathered,
-            "grad_reduce_bytes_per_device": sum(
-                self._leaf_comm_bytes(l, compute_dtype)
-                for l in jax.tree_util.tree_leaves(params)
-            ),
-        }
+        grad = sum(
+            self._leaf_comm_bytes(l, compute_dtype)
+            for l in jax.tree_util.tree_leaves(params)
+        )
+        return self._comm_row(gathered=gathered, grad=grad)
+
+    def opt_state_sharding(self, opt_state, params, hints=None):
+        # Mirrors constrain_step's rule exactly: every ndim>=1 leaf pins to
+        # its per-shape ZeRO spec, scalars replicate.
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def place(a):
+            if getattr(a, "ndim", 0) < 1:
+                return rep
+            return NamedSharding(self.mesh, self._spec_for(a.shape))
+
+        return jax.tree_util.tree_map(place, opt_state)
 
 
 class FSDP(FullyShardedDataParallel):

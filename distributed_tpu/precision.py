@@ -127,6 +127,14 @@ class Policy:
     def needs_compute_cast(self) -> bool:
         return self.compute_dtype != self.param_dtype
 
+    @property
+    def compute_itemsize(self) -> int:
+        """Bytes per element at the compute dtype — the pricing hook the
+        auto-shard planner (and Strategy.comm_bytes_estimate callers) use
+        to cost activations and compute-dtype collectives without
+        materializing anything."""
+        return int(self.compute_dtype.itemsize)
+
     def cast_to_compute(self, tree, dtype_hints: Optional[Dict] = None):
         """The master->compute cast: floating leaves cast to
         ``compute_dtype``, everything else (ints, rng keys) untouched.

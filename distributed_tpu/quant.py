@@ -183,10 +183,40 @@ def quantize_model(model, *, min_ndim: int = 2):
     return model
 
 
+def abstract_quantize_tree(tree, *, min_ndim: int = 2):
+    """Abstract (``jax.ShapeDtypeStruct``) twin of :func:`quantize_tree`:
+    the SHAPE of the int8+scales tree a quantize-on-load would produce,
+    without any weights. The auto-shard planner's pricing hook for
+    quantized-serving footprints — feed the result to
+    ``profiler.tree_bytes_per_device`` / :func:`tree_param_bytes` /
+    ``Strategy.comm_bytes_estimate`` to cost an int8 deployment from
+    shapes alone (int8 leaves price at 1 byte everywhere)."""
+
+    def walk(t):
+        if isinstance(t, dict):
+            return {k: walk(v) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            return type(t)(walk(v) for v in t)
+        shape = tuple(getattr(t, "shape", ()))
+        if (
+            len(shape) >= min_ndim
+            and jnp.issubdtype(jnp.result_type(t), jnp.floating)
+        ):
+            return {
+                QKEY: jax.ShapeDtypeStruct(shape, jnp.int8),
+                SKEY: jax.ShapeDtypeStruct(shape[-1:], jnp.float32),
+            }
+        return t
+
+    return walk(tree)
+
+
 def tree_param_bytes(tree) -> int:
     """Global logical byte count of a (possibly quantized) param tree —
     the serving-HBM number ``bench.py quant`` compares across formats
-    (per-DEVICE resident bytes come from profiler.tree_bytes_per_device)."""
+    (per-DEVICE resident bytes come from profiler.tree_bytes_per_device).
+    Works on live arrays AND abstract ``ShapeDtypeStruct`` leaves (the
+    planner's dry-run path)."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         size = int(np.prod(leaf.shape)) if getattr(leaf, "shape", ()) else 1
@@ -198,6 +228,7 @@ __all__ = [
     "is_quantized",
     "is_quantized_leaf",
     "shape_of",
+    "abstract_quantize_tree",
     "quantize_leaf",
     "quantize_tree",
     "quantize_model",

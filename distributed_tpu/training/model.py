@@ -183,6 +183,9 @@ class Model:
         self._resumed_step = None  # set by a restoring ModelCheckpoint
         self._stall_timer = None  # live StepTimer of the fit in progress
         self.last_fit_telemetry = None  # stall_report() of the last fit
+        self.last_plan = None  # auto_shard.Plan of compile(strategy="auto")
+        self._auto_shard = None  # planner config, set by compile()
+        self._auto_grad_accum = None  # planner-chosen fit(grad_accum=) default
         self._param_hints = {}  # TP role tree, populated by build()
         self._seed = 0
         self._train_step = None
@@ -199,6 +202,12 @@ class Model:
         according to the strategy (replicated under DP)."""
         self.input_shape = tuple(int(d) for d in input_shape)
         self._seed = seed
+        if self._auto_shard is not None and self.compiled:
+            # compile(strategy="auto"): pick the strategy/precision/K
+            # BEFORE materializing — the planner prices candidates from
+            # abstract shapes, so the 3x-params optimizer tree is never
+            # built under a layout that would then be thrown away.
+            self._commit_auto_plan()
         key = jax.random.PRNGKey(seed)
         params, state, _ = self.module.init(key, self.input_shape)
         # Tensor-parallel role tree (empty for unhinted models); strategies
@@ -231,9 +240,36 @@ class Model:
         head_chunks: Optional[int] = None,
         steps_per_execution: Optional[int] = None,
         precision=None,
+        strategy=None,
+        hbm_cap_bytes: Optional[int] = None,
+        measure: bool = False,
+        auto_options: Optional[dict] = None,
         **optimizer_kwargs,
     ):
-        """``head_chunks=C``: fused chunked head-loss for token models.
+        """``strategy``: override the construction-scope strategy. A
+        ``Strategy`` instance replaces it directly (live params are
+        re-placed). The string ``"auto"`` hands the choice to the
+        auto-shard planner (``parallel.auto_shard.plan_sharding``): at
+        build time it enumerates strategy x precision x grad_accum x
+        steps_per_execution candidates over the live topology, prices
+        per-device state bytes (via ``jax.eval_shape`` — no tree is
+        materialized per candidate) and per-step collective traffic
+        (``Strategy.comm_bytes_estimate``), prunes configs that exceed
+        ``hbm_cap_bytes`` (the ``Feasibility`` predicate), ranks the rest
+        by a compute+comm+dispatch cost model, and commits the winner —
+        including its precision policy, ``steps_per_execution``, and a
+        default ``fit(grad_accum=...)``. Dimensions you set explicitly
+        (``precision=...``, ``steps_per_execution=...``) are PINNED, not
+        searched. ``measure=True`` times the top-k shortlist with short
+        real dispatches before committing (materializes params per
+        shortlisted candidate — the estimate-only default does not).
+        ``auto_options`` passes planner knobs through (``batch_size``,
+        ``devices``, ``grad_accums``, ``precisions``, ``include_tp``,
+        ``top_k``). The decision record lands in ``model.last_plan``,
+        ``model.last_fit_telemetry["plan"]``, and the JSONL event log
+        (``auto_shard_plan``); see docs/PERF.md "Autotuned sharding".
+
+        ``head_chunks=C``: fused chunked head-loss for token models.
         The module's FINAL layer (the vocab head) and the loss are applied
         over C chunks of the flattened token axis inside a rematerialized
         ``lax.scan`` — the full (tokens, vocab) logits tensor never
@@ -298,6 +334,40 @@ class Model:
         param-gather traffic under bf16 (docs/PERF.md "Mixed
         precision"). ``None`` (default) disables the policy machinery
         entirely — the pre-policy f32 behavior, byte-for-byte."""
+        if strategy is None:
+            # A plain recompile keeps the current strategy but drops any
+            # pending auto plan (and its fit-default grad_accum): the new
+            # optimizer/loss configuration invalidates the old decision.
+            self._auto_shard = None
+            self._auto_grad_accum = None
+            self.last_plan = None
+        elif isinstance(strategy, str) and strategy == "auto":
+            self._auto_shard = {
+                "hbm_cap_bytes": hbm_cap_bytes,
+                "measure": bool(measure),
+                "pinned_precision": precision is not None,
+                "pinned_k": steps_per_execution is not None,
+                **(dict(auto_options) if auto_options else {}),
+            }
+            self.last_plan = None
+            self._auto_grad_accum = None
+        elif isinstance(strategy, Strategy):
+            self._auto_shard = None
+            self._auto_grad_accum = None
+            self.last_plan = None
+            self.strategy = strategy
+            if self.built:
+                # Re-place live params/state under the new strategy (the
+                # opt state re-inits below, like every recompile).
+                self.params = strategy.put_params(
+                    self.params, hints=self._param_hints
+                )
+                self.state = strategy.put_params(self.state)
+        else:
+            raise ValueError(
+                "strategy must be None, the string 'auto', or a "
+                f"parallel.Strategy instance; got {strategy!r}"
+            )
         self.precision = precision_lib.get(precision)
         self.tx = optim.get(optimizer, **optimizer_kwargs)
         if grad_clip is not None:
@@ -357,8 +427,147 @@ class Model:
         self._decode_dtype = None
         self._generate_fns = {}
         if self.built:
+            if self._auto_shard is not None:
+                # Already built: plan now (input shape is known) and
+                # re-place the live tree under the winner.
+                self._commit_auto_plan(replace_live=True)
             self.opt_state = self.strategy.init_opt_state(self.tx, self.params)
         return self
+
+    # -------------------------------------------------------- auto sharding
+    def _commit_auto_plan(self, replace_live: bool = False):
+        """Run the auto-shard planner (``compile(strategy="auto")``) and
+        commit its winner: strategy, precision policy,
+        ``steps_per_execution``, and the default ``fit(grad_accum=...)``.
+        The Plan is kept on ``self.last_plan``, summarized into
+        ``last_fit_telemetry["plan"]`` at fit end, and emitted to the
+        JSONL event log as ``auto_shard_plan``. ``replace_live=True``
+        re-places already-materialized params/state under the winner (the
+        compile-after-build path)."""
+        from ..parallel import auto_shard as auto_lib
+        from ..utils import events as events_lib
+
+        cfg = dict(self._auto_shard)
+        measure = cfg.pop("measure", False)
+        hbm_cap = cfg.pop("hbm_cap_bytes", None)
+        pinned_precision = cfg.pop("pinned_precision", False)
+        pinned_k = cfg.pop("pinned_k", False)
+        if pinned_precision and "precisions" not in cfg:
+            cfg["precisions"] = (
+                self.precision.name if self.precision is not None else None,
+            )
+        if pinned_k and "steps_per_execution" not in cfg:
+            cfg["steps_per_execution"] = (self.steps_per_execution or 1,)
+        devices = cfg.get("devices")
+        measure_fn = self._measure_candidate if measure else None
+        plan = auto_lib.plan_sharding(
+            self.module, self.input_shape, tx=self.tx,
+            hbm_cap_bytes=hbm_cap, measure=measure, measure_fn=measure_fn,
+            seed=self._seed, **cfg,
+        )
+        chosen = plan.chosen_candidate()
+        self.strategy = chosen.build_strategy(devices)
+        if not pinned_k:
+            self.steps_per_execution = (
+                chosen.steps_per_execution
+                if chosen.steps_per_execution > 1 else None
+            )
+        current = self.precision.name if self.precision is not None else None
+        if chosen.precision != current:
+            # Only reachable when precision was NOT pinned at compile, so
+            # the tx cannot already carry a loss-scaling wrapper.
+            self.precision = precision_lib.get(chosen.precision)
+            if self.precision is not None and self.precision.loss_scaling:
+                self.tx = optim.dynamic_loss_scaling(
+                    self.tx,
+                    init_scale=self.precision.initial_loss_scale,
+                    growth_interval=(
+                        self.precision.loss_scale_growth_interval
+                    ),
+                    factor=self.precision.loss_scale_factor,
+                )
+        self._auto_grad_accum = (
+            chosen.grad_accum if chosen.grad_accum > 1 else None
+        )
+        self.last_plan = plan
+        # Strategy/precision changed under every cached compiled step.
+        self._train_step = self._eval_step = self._predict_step = None
+        self._multi_train_steps = {}
+        self._accum_train_steps = {}
+        self._decode_dtype = None
+        self._generate_fns = {}
+        if replace_live:
+            self.params = self.strategy.put_params(
+                self.params, hints=self._param_hints
+            )
+            self.state = self.strategy.put_params(self.state)
+        summary = plan.summary()
+        events_lib.emit("auto_shard_plan", **summary)
+        if jax.process_index() == 0:
+            dlog.event("auto_shard_plan", **summary)
+            dlog.info(
+                f"auto-shard: chose {plan.chosen['label']} "
+                f"(est {plan.chosen['est_step_seconds']:.4f}s/step, "
+                f"{plan.chosen['state_bytes_per_device']} state B/dev; "
+                f"{len(plan.candidates)} feasible, {len(plan.pruned)} "
+                f"pruned, tie_break={plan.tie_break})"
+            )
+        return plan
+
+    def _measure_candidate(self, cand, ctx, steps: int = 3):
+        """Time one shortlisted candidate with short REAL dispatches:
+        materialize params/opt under its strategy, run the actual jitted
+        train-step body on a synthetic batch (input dtype/label shape from
+        the planner's abstract forward probe), and return seconds per
+        step (first dispatch — the compile — excluded). Returns None when
+        the candidate can't be timed (e.g. a loss that rejects the
+        synthetic labels); the planner then falls back to its estimate
+        order for that row."""
+        strat = cand.build_strategy(ctx["devices"])
+        prev_strategy, prev_precision = self.strategy, self.precision
+        try:
+            self.strategy = strat
+            self.precision = precision_lib.get(cand.precision)
+            key = jax.random.PRNGKey(self._seed)
+            params, state, _ = self.module.init(key, self.input_shape)
+            hints = self.module.sharding_hints()
+            params = strat.put_params(params, hints=hints)
+            state = strat.put_params(state)
+            opt = strat.init_opt_state(self.tx, params)
+            b = ctx["batch_size"]
+            x = np.zeros((b,) + self.input_shape,
+                         np.dtype(jnp.dtype(ctx["x_dtype"]).name))
+            y = np.zeros(ctx["logits_shape"][:-1], np.int32)
+            batch = strat.put_batch({"x": x, "y": y})
+            step = jax.jit(self._train_step_body(), donate_argnums=(0, 1, 2))
+            policy = self.precision
+
+            def run(*args):
+                with strat.scope():
+                    if policy is None:
+                        return step(*args)
+                    with policy.scope():
+                        return step(*args)
+
+            rng = jax.random.PRNGKey(0)
+            params, state, opt, loss, _ = run(
+                params, state, opt, batch["x"], batch["y"], rng
+            )
+            np.asarray(jax.device_get(loss))  # compile + warm, excluded
+            t0 = time.perf_counter()
+            for _ in range(max(1, steps)):
+                params, state, opt, loss, _ = run(
+                    params, state, opt, batch["x"], batch["y"], rng
+                )
+            np.asarray(jax.device_get(loss))
+            return (time.perf_counter() - t0) / max(1, steps)
+        except Exception as e:
+            dlog.warning(
+                f"auto-shard: could not measure {cand.label()}: {e}"
+            )
+            return None
+        finally:
+            self.strategy, self.precision = prev_strategy, prev_precision
 
     @property
     def num_params(self) -> int:
@@ -980,6 +1189,10 @@ class Model:
                 raise ValueError(f"batch_size {batch_size} > dataset size {n}")
             if steps_per_epoch is None:
                 steps_per_epoch = n // batch_size
+        if grad_accum is None:
+            # compile(strategy="auto") may have planned an accumulation
+            # factor (to fit the HBM cap); an explicit fit arg still wins.
+            grad_accum = self._auto_grad_accum
         if grad_accum is not None and (
             not isinstance(grad_accum, (int, np.integer)) or grad_accum < 1
         ):
@@ -1320,7 +1533,13 @@ class Model:
                 self.precision.compute_dtype
                 if self.precision is not None else None
             ),
+            hints=self._param_hints,
         )
+        # The auto-shard decision record rides with every fit it governed:
+        # chosen config, predicted bytes/traffic, and the pruned
+        # candidates' rationale (docs/PERF.md "Autotuned sharding").
+        if self.last_plan is not None:
+            report["plan"] = self.last_plan.summary()
         self.last_fit_telemetry = report
         self._stall_timer = None
         return history

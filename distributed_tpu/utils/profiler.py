@@ -66,21 +66,48 @@ def device_memory_stats(device=None):
 
 
 def tree_bytes_per_device(*trees) -> dict:
-    """Measured per-device resident bytes of pytrees of arrays, from the
-    size of each ``jax.Array``'s addressable shard buffers (no transfers,
-    no allocator needed — works on every backend, including the CPU sim).
+    """Per-device resident bytes of pytrees of arrays, live OR abstract.
+
+    Live ``jax.Array`` leaves are measured from their addressable shard
+    buffers (no transfers, no allocator needed — works on every backend,
+    including the CPU sim). Abstract ``jax.ShapeDtypeStruct`` leaves are
+    *predicted* from their attached sharding: a leaf carrying a
+    ``NamedSharding`` contributes ``prod(shard_shape) * itemsize`` to every
+    device of its mesh (exactly what materializing it would cost — the
+    auto-shard planner's dry-run path, which never builds the 30M-param
+    tree it is pricing); an abstract leaf with no sharding counts once into
+    a synthetic ``"<abstract>"`` device (the single-device placement).
     Replicated leaves count once PER DEVICE (that is the cost replication
     pays and sharding avoids); host numpy leaves are skipped. Returns
     ``{"max_bytes_per_device", "total_bytes", "devices"}`` where
-    ``total_bytes`` sums over all devices."""
+    ``total_bytes`` sums over all devices. Live and abstract numbers agree
+    exactly for the same tree + placement (pinned by
+    tests/test_autoshard.py)."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
     per: dict = {}
     for tree in trees:
         for leaf in jax.tree_util.tree_leaves(tree):
-            if not isinstance(leaf, jax.Array):
-                continue
-            for s in leaf.addressable_shards:
-                key = str(s.device)
-                per[key] = per.get(key, 0) + int(s.data.nbytes)
+            if isinstance(leaf, jax.Array):
+                for s in leaf.addressable_shards:
+                    key = str(s.device)
+                    per[key] = per.get(key, 0) + int(s.data.nbytes)
+            elif isinstance(leaf, jax.ShapeDtypeStruct):
+                itemsize = jax.numpy.dtype(leaf.dtype).itemsize
+                sh = getattr(leaf, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    nbytes = int(
+                        np.prod(sh.shard_shape(leaf.shape), dtype=np.int64)
+                    ) * itemsize
+                    for d in sh.mesh.devices.flat:
+                        key = str(d)
+                        per[key] = per.get(key, 0) + nbytes
+                else:
+                    nbytes = int(
+                        np.prod(leaf.shape, dtype=np.int64)
+                    ) * itemsize
+                    per["<abstract>"] = per.get("<abstract>", 0) + nbytes
     return {
         "max_bytes_per_device": max(per.values()) if per else 0,
         "total_bytes": sum(per.values()),

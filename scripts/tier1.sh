@@ -65,6 +65,18 @@ if [ -n "${TIER1_QUANT_SMOKE:-}" ]; then
         -q --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_AUTOSHARD_SMOKE=1: same idea for the auto-shard planner — runs
+# ONLY tests/test_autoshard.py (+ the bench autoshard smoke, ~35 s) so
+# planner/cost-model/strategy-seam changes iterate fast. The measured-
+# shortlist path stays @slow (run it with -m slow when touching the
+# measure machinery). NOT a tier-1 substitute.
+if [ -n "${TIER1_AUTOSHARD_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_autoshard.py \
+        "tests/test_bench.py::test_bench_autoshard_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 # TIER1_ELASTIC_SMOKE=1: same idea for the elastic-gang subsystem — runs
 # the elastic policy/supervisor/cluster/pipeline units plus the N->N'
 # sharded-restore tests (~15 s). The real-gang shrink/grow fault matrix

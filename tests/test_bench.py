@@ -154,6 +154,34 @@ def test_bench_quant_smoke():
             == pytest.approx(2.0)
 
 
+def test_bench_autoshard_smoke():
+    """The autoshard mode at tiny shapes: the full path — two
+    compile(strategy="auto") builds, the measured dp/zero1/fsdp
+    comparison, the midpoint synthetic cap, the pruned-candidate
+    rationale — and the artifact schema. The known-best PICK assertions
+    (capped -> fsdp with replicated pruned; uncapped within tolerance of
+    measured best) hold at every shape; the real run is `python bench.py
+    autoshard` (BENCH_autoshard.json) on the BENCH_zero shapes."""
+    out = bench.bench_autoshard(
+        vocab=64, num_layers=1, d_model=32, num_heads=2, seq_len=16,
+        batch=8, big_vocab=128, big_layers=1, big_d_model=64,
+        hbm_cap_mb="midpoint", big_batch=8, warmup=1, measure=2, windows=1,
+    )
+    assert out["unit"] == "steps/s" and out["value"] > 0
+    assert out["picked"] in out["measured_steps_per_sec"]
+    assert set(out["measured_steps_per_sec"]) == {"dp", "zero1", "fsdp"}
+    assert out["pick_within_tol_of_best"] in (True, False)
+    assert out["plan"]["chosen"]["config"]["strategy"] == out["picked"]
+    (capped,) = out["rows"]
+    assert capped["value"] == "fsdp"
+    assert capped["replicated_pruned"] is True
+    assert "hbm_cap" in capped["replicated_prune_reason"]
+    assert capped["picked_state_bytes_per_device"] < \
+        capped["replicated_state_bytes_per_device"]
+    assert capped["telemetry_plan_recorded"] is True
+    assert capped["final_loss"] > 0
+
+
 def test_bench_fused_update_smoke():
     """The fused_update mode at tiny shapes: schema + the mechanism
     fields. No speedup assertion on CPU — the kernel runs in Pallas
