@@ -336,6 +336,104 @@ def bench_overlap(batch=32, measure_steps=24, depths=(0, 2), repeats=3,
     return out
 
 
+# ----------------------------------------------------------- streaming input --
+def bench_input(batch=32, measure_steps=24, workers=(0, 1, 2, 4), repeats=3,
+                n_records=2048, image_hw=(28, 28), decode_latency_ms=4.0,
+                records_dir=None):
+    """Decode-parallelism win on a DECODE-BOUND streaming config
+    (``python bench.py input``, artifact BENCH_input.json; docs/PERF.md
+    "Streaming input"). A directory of indexed record shards
+    (``data.write_records``: zlib-compressed synthetic images, one
+    variable-length record each) feeds a cheap mnist_cnn through
+    ``Pipeline(RecordSource(...), decode_workers=W)`` for each W. The
+    decode_fn is genuinely costly per record — a blocking stage
+    (``decode_latency_ms``: the RTT of a remote decode service or
+    object-store read, a sleep to the CPU, which is exactly what a
+    blocked read is) plus a real zlib decompress + unpack — so at W=0 the
+    input side, not the device, bounds the step rate even under
+    ``fit(prefetch=2)``: prefetch's single producer hides input LATENCY
+    behind compute but serializes the decodes themselves. decode_workers
+    adds the missing PARALLELISM: W workers decode W batches' records
+    concurrently (work assigned by step, reassembled in order — the
+    stream stays bit-identical, which tests/test_records.py pins).
+
+    Reports steps/s and the fit loop's own input_stall_fraction per W,
+    plus speedup_vs_w0. Same honesty note as bench_overlap: on this
+    1-core container the parallelizable cost is the blocking stage;
+    CPU-bound decode (the zlib part) additionally parallelizes wherever
+    spare cores exist — same mechanism, more win."""
+    import tempfile
+    import zlib as _zlib
+
+    from distributed_tpu.data import Pipeline, RecordSource, write_records
+    from distributed_tpu.utils.profiler import StepTimer
+
+    x, y = dtpu.data.synthetic_images(n_records, image_hw, 10, 0)
+    x = x[..., None]
+    row_shape = x.shape[1:]
+    directory = records_dir or tempfile.mkdtemp(prefix="dtpu-bench-records-")
+    write_records(
+        directory,
+        (bytes([int(lbl)]) + _zlib.compress(img.tobytes(), 6)
+         for img, lbl in zip(x, y)),
+    )
+    lat = float(decode_latency_ms) / 1e3
+
+    def decode(b):
+        if lat:
+            time.sleep(lat)  # the remote-decode/storage RTT, per record
+        raw = _zlib.decompress(b[1:])
+        row = np.frombuffer(raw, np.uint8).reshape(row_shape)
+        return row.astype(np.float32) * np.float32(1.0 / 255.0), int(b[0])
+
+    rows = []
+    for w in workers:
+        strategy = _strategy()
+        with strategy.scope():
+            model = dtpu.Model(dtpu.models.mnist_cnn())
+            model.compile(
+                optimizer=dtpu.optim.SGD(0.001),
+                loss="sparse_categorical_crossentropy",
+                metrics=["accuracy"],
+            )
+        model.build(row_shape)
+        with Pipeline(RecordSource(directory, decode_fn=decode), None,
+                      batch, seed=0, decode_workers=w) as pipe:
+            # Warmup epoch compiles the step program outside the timing.
+            model.fit(pipe, epochs=1, steps_per_epoch=2, verbose=0)
+            rates, stalls = [], []
+            for _ in range(max(1, repeats)):
+                timer = StepTimer(warmup=0)
+                cbs = [dtpu.callbacks.LambdaCallback(
+                    on_batch_end=lambda m, s, logs: timer.tick()
+                )]
+                model.fit(pipe, epochs=1, steps_per_epoch=measure_steps,
+                          verbose=0, callbacks=cbs)
+                rates.append(timer.steps_per_sec)
+                stalls.append(
+                    model.last_fit_telemetry["input_stall_fraction"]
+                )
+        rows.append({
+            "metric": f"records_decode_w{w}_steps_per_sec_b{batch}",
+            "value": round(float(np.median(rates)), 3),
+            "unit": "steps/s",
+            "decode_workers": w,
+            "input_stall_fraction": round(float(np.median(stalls)), 4),
+            "window_steps_per_sec": [round(r, 3) for r in rates],
+        })
+    out = dict(rows[0])
+    out["decode_latency_ms_per_record"] = float(decode_latency_ms)
+    if len(rows) > 1:
+        out["rows"] = rows[1:]
+        if rows[0]["value"] > 0:
+            out["speedup_vs_w0"] = {
+                f"w{r['decode_workers']}":
+                    round(r["value"] / rows[0]["value"], 2)
+                for r in rows[1:]
+            }
+    return out
+
+
 # ------------------------------------------------------------- convergence --
 def _augment_shifts(x, y, shifts=(-2, -1, 0, 1, 2)):
     """Static shift augmentation (every (dr, dc) pair in ``shifts``^2):
@@ -1949,10 +2047,10 @@ def bench_autoshard(vocab=512, num_layers=2, d_model=256, num_heads=4,
 
 def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
                 "resnet50", "lm")):
-    known = {"mnist", "multistep", "overlap", "convergence", "cifar",
-             "resnet50", "lm", "longctx", "resilience", "zero", "precision",
-             "compile_cache", "serve", "elastic", "quant", "fused_update",
-             "autoshard"}
+    known = {"mnist", "multistep", "overlap", "input", "convergence",
+             "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
+             "precision", "compile_cache", "serve", "elastic", "quant",
+             "fused_update", "autoshard"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -1964,6 +2062,10 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         extra.append(bench_multi_step())
     if "overlap" in modes:
         extra.append(bench_overlap())
+    if "input" in modes:
+        # Opt-in: decode-bound record streaming at decode_workers W
+        # (BENCH_input.json; docs/PERF.md "Streaming input").
+        extra.append(bench_input())
     if "convergence" in modes:
         extra.append(bench_convergence())
     if "cifar" in modes:

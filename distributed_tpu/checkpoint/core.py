@@ -143,6 +143,34 @@ def _atomic_write(path: Path, write_fn):
             os.unlink(tmp_name)
 
 
+def _data_state_of(model, step: int) -> Optional[dict]:
+    """The active fit data source's iterator cursor, as JSON-able meta —
+    None when there is no source, it has no ``state_dict``, or its state
+    fails to serialize (a checkpoint must never die for its data cursor;
+    resume then falls back to the seek path). ``step`` is the step the
+    model trained to: it overrides the source's own position, which a
+    prefetch producer may have staged AHEAD of the consumed stream."""
+    src = getattr(model, "_fit_source", None)
+    if src is None or not hasattr(src, "state_dict"):
+        return None
+    from ..utils import logging as _dlog
+
+    try:
+        try:
+            state = src.state_dict(consumed_steps=step)
+        except TypeError:  # sources with a plain state_dict() signature
+            state = src.state_dict()
+        json.dumps(state)  # meta is JSON; reject unserializable state now
+        return state
+    except Exception as e:
+        _dlog.warning(
+            f"Checkpointer: data source state_dict failed ({e}); the "
+            "checkpoint carries no iterator state (resume will use the "
+            "seek path)"
+        )
+        return None
+
+
 def _device_snapshot(tree):
     """Donation-safe copy of a pytree for a background writer: jax leaves
     get an on-device copy (enqueued NOW, on the caller's thread, so it is
@@ -277,6 +305,20 @@ class Checkpointer:
     When the newest file is corrupt anyway (torn by the filesystem, or a
     fault-injection test), auto-restore skips it and falls back to the
     previous step instead of failing the relaunch.
+
+    Checkpoints also carry ITERATOR STATE: when the model is mid-``fit``
+    over a data source exposing ``state_dict()`` (``data.Pipeline``,
+    including record-backed streaming pipelines), each save records the
+    source's cursor — aligned to the step the model actually TRAINED,
+    not the (possibly prefetch-staged-ahead) source position — in the
+    checkpoint meta, and a resuming ``fit`` restores it via the source's
+    ``load_state()`` (O(1), no replay; identity fields like seed and
+    batch_size are validated loudly). The state is PORTABLE across
+    worker counts and shardings: it records the GLOBAL stream cursor,
+    never the decode-worker count or the per-host shard, so a resumed
+    run may use different ``decode_workers`` or a resized gang
+    (``Pipeline.reshard("auto")``) and still consume the exact stream
+    the interrupted run would have (docs/API.md "Data").
 
     ``async_save=True`` moves the expensive half of every save — the
     device->host fetch, npz serialization, fsync, gc, and the atomic
@@ -417,6 +459,9 @@ class Checkpointer:
             "seed": int(model._seed),
             "input_shape": list(model.input_shape or ()),
         }
+        dstate = _data_state_of(model, int(step))
+        if dstate is not None:
+            meta["data_state"] = dstate
         # Serialize the step family: an older in-flight write must land
         # (and any error surface) before a newer save may start.
         self.wait()
@@ -512,6 +557,10 @@ class Checkpointer:
             )
         model.step = int(meta["step"])
         model._seed = int(meta.get("seed", model._seed))
+        # Iterator-state handoff: fit() reads this on resume and restores
+        # the source via load_state() instead of the bare seek, getting
+        # loud validation of the stream identity for free.
+        model._restored_data_state = meta.get("data_state")
         return model.step
 
     def _restore_multihost(self, model, step: Optional[int]) -> int:
@@ -634,4 +683,8 @@ class Checkpointer:
             )
         model.step = agreed
         model._seed = seed
+        # Meta lives only on the chief here; no process restores iterator
+        # state (fit's seek path realigns the stream from the agreed step,
+        # which is exact for (seed, step)-deterministic sources).
+        model._restored_data_state = None
         return model.step

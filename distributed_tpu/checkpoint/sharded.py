@@ -48,7 +48,12 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
-from .core import _atomic_write, _is_chief, iter_leaf_paths as _iter_leaf_paths
+from .core import (
+    _atomic_write,
+    _data_state_of,
+    _is_chief,
+    iter_leaf_paths as _iter_leaf_paths,
+)
 
 __all__ = ["ShardedCheckpointer"]
 
@@ -225,6 +230,14 @@ class ShardedCheckpointer:
                 "nprocs": jax.process_count(),
                 "leaves": leaves_meta,
             }
+            # Iterator cursor of the active fit source (data.Pipeline
+            # state_dict), aligned to the trained step — the manifest is
+            # read by EVERY process at restore (shared directory), so
+            # unlike Checkpointer's chief-only meta it resumes streaming
+            # input on whole gangs, including resized (elastic) ones.
+            dstate = _data_state_of(model, int(step))
+            if dstate is not None:
+                manifest["data_state"] = dstate
             _atomic_write(
                 step_dir / "manifest.json",
                 lambda tmp: Path(tmp).write_text(json.dumps(manifest)),
@@ -395,4 +408,8 @@ class ShardedCheckpointer:
             model.opt_state = restored["opt_state"]
         model.step = int(manifest["step"])
         model._seed = int(manifest.get("seed", model._seed))
+        # fit() restores the data source from this via load_state() (the
+        # state records the GLOBAL stream cursor, so it composes with
+        # reshard("auto") after an elastic resize).
+        model._restored_data_state = manifest.get("data_state")
         return model.step

@@ -11,12 +11,16 @@ from .datasets import (
 from .filesource import FileSource, write_shards
 from .pipeline import Pipeline, native_available
 from .prefetch import DevicePrefetcher
+from .records import RecordCorruptionError, RecordSource, write_records
 
 __all__ = [
     "Pipeline",
     "DevicePrefetcher",
     "FileSource",
     "write_shards",
+    "RecordSource",
+    "RecordCorruptionError",
+    "write_records",
     "native_available",
     "load",
     "fetch_mnist",

@@ -14,10 +14,17 @@
 // Python via ctypes; see ../pipeline.py, which also carries a pure-Python
 // fallback with the same semantics.
 //
-// Determinism: batch b of pass p depends only on (seed, p, b) — a
-// splitmix64-seeded Fisher-Yates permutation per pass — so two pipelines
-// constructed with the same arguments emit identical streams regardless of
-// thread count or timing.
+// Determinism: batch b of pass p depends only on (seed, p, b), so two
+// pipelines constructed with the same arguments emit identical streams
+// regardless of thread count or timing. With external_perms (the default
+// from Python since the shuffle unification) the per-pass permutation is
+// SUPPLIED by the driver via dtpu_pipeline_supply_perm — one numpy
+// computation shared with the Python fallback, so native and Python emit
+// bit-identical streams; workers block until the pass they need has been
+// supplied (the driver hands over every reachable pass before each next()
+// call, so they never wait in steady state). Without it (legacy mode,
+// DTPU_NATIVE_LEGACY_SHUFFLE=1), a splitmix64-seeded Fisher-Yates
+// permutation is generated here, as before the unification.
 
 #include <algorithm>
 #include <atomic>
@@ -89,31 +96,45 @@ struct DtpuPipeline {
   std::atomic<int64_t> consumed{0};   // next step the consumer will take
   bool stop = false;
 
-  // Lazily-built per-pass permutations (guarded by perm_mu). Only passes
+  // Per-pass permutations (guarded by perm_mu): generated lazily here
+  // (legacy mode) or supplied by the driver (external_perms). Only passes
   // that can still be in a producer's fill window are retained; older ones
   // are pruned so memory stays bounded over arbitrarily long runs (each
   // pass's permutation is n * 8 bytes — ~10MB at ImageNet scale).
   // shared_ptr keeps a pruned-but-in-use permutation alive for its reader.
   std::mutex perm_mu;
+  std::condition_variable cv_perm;
   std::map<int64_t, std::shared_ptr<std::vector<int64_t>>> perms;
+  bool external_perms = false;
+  bool perm_stop = false;  // guarded by perm_mu; set at destroy
 
   std::vector<std::thread> workers;
 
   std::shared_ptr<std::vector<int64_t>> perm_for(int64_t pass) {
-    std::lock_guard<std::mutex> lock(perm_mu);
+    std::unique_lock<std::mutex> lock(perm_mu);
     auto it = perms.find(pass);
     if (it == perms.end()) {
-      auto order = std::make_shared<std::vector<int64_t>>(n);
-      for (int64_t i = 0; i < n; ++i) (*order)[i] = i;
-      if (shuffle) {
-        // Seed mixes (seed, pass) so each pass reshuffles deterministically.
-        SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)pass + 1);
-        for (int64_t i = n - 1; i > 0; --i) {
-          int64_t j = (int64_t)rng.below((uint64_t)i + 1);
-          std::swap((*order)[i], (*order)[j]);
+      if (external_perms) {
+        // The driver supplies every reachable pass before each next()
+        // call; a wait here only happens at startup or right after a
+        // seek, and destroy() unblocks it via perm_stop.
+        cv_perm.wait(lock, [&] { return perm_stop || perms.count(pass); });
+        if (perm_stop) return nullptr;
+        it = perms.find(pass);
+      } else {
+        auto order = std::make_shared<std::vector<int64_t>>(n);
+        for (int64_t i = 0; i < n; ++i) (*order)[i] = i;
+        if (shuffle) {
+          // Seed mixes (seed, pass) so each pass reshuffles
+          // deterministically.
+          SplitMix64 rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)pass + 1);
+          for (int64_t i = n - 1; i > 0; --i) {
+            int64_t j = (int64_t)rng.below((uint64_t)i + 1);
+            std::swap((*order)[i], (*order)[j]);
+          }
         }
+        it = perms.emplace(pass, std::move(order)).first;
       }
-      it = perms.emplace(pass, std::move(order)).first;
     }
     std::shared_ptr<std::vector<int64_t>> result = it->second;
     // Any step still fillable is >= consumed, so passes below
@@ -123,11 +144,21 @@ struct DtpuPipeline {
     return result;
   }
 
-  void fill(Slot& slot, int64_t step) {
+  void supply_perm(int64_t pass, const int64_t* perm) {
+    auto order = std::make_shared<std::vector<int64_t>>(perm, perm + n);
+    {
+      std::lock_guard<std::mutex> lock(perm_mu);
+      perms.emplace(pass, std::move(order));
+    }
+    cv_perm.notify_all();
+  }
+
+  bool fill(Slot& slot, int64_t step) {
     int64_t pass = step / steps_per_pass;
     int64_t within = step % steps_per_pass;
     // Hold the shared_ptr for the whole fill: pruning may drop the map entry.
     std::shared_ptr<std::vector<int64_t>> order_sp = perm_for(pass);
+    if (!order_sp) return false;  // stopped while waiting for the pass
     const std::vector<int64_t>& order = *order_sp;
     const int64_t start = within * batch + shard_index * shard_rows;
     slot.x.resize((size_t)(shard_rows * row));
@@ -147,6 +178,7 @@ struct DtpuPipeline {
     }
     // slot.step is published under mu in worker(): the consumer's wait
     // predicate reads it, and an unlocked write here would race.
+    return true;
   }
 
   void worker() {
@@ -161,7 +193,7 @@ struct DtpuPipeline {
         cv_produce.wait(lock, [&] { return stop || consumed + depth > step; });
         if (stop) return;
       }
-      fill(slot, step);
+      if (!fill(slot, step)) return;  // destroyed mid-wait for a perm
       {
         std::lock_guard<std::mutex> lock(mu);
         slot.step = step;
@@ -181,7 +213,8 @@ DtpuPipeline* dtpu_pipeline_create_spans(
     const uint8_t* const* xs, const int64_t* span_rows, int64_t n_spans,
     const int32_t* y, int64_t n, int64_t row_elems, int64_t batch,
     int shuffle, uint64_t seed, int depth, int threads, float scale,
-    int64_t start_step, int64_t shard_index, int64_t shard_count) {
+    int64_t start_step, int64_t shard_index, int64_t shard_count,
+    int external_perms) {
   if (n <= 0 || batch <= 0 || batch > n || row_elems <= 0) return nullptr;
   if (n_spans < 1) return nullptr;
   if (shard_count < 1 || shard_index < 0 || shard_index >= shard_count ||
@@ -212,6 +245,7 @@ DtpuPipeline* dtpu_pipeline_create_spans(
   p->shuffle = shuffle != 0;
   p->seed = seed;
   p->scale = scale;
+  p->external_perms = external_perms != 0;
   p->depth = depth < 1 ? 1 : depth;
   // Resume support: start emitting at an arbitrary global step (O(1) seek —
   // step order depends only on (seed, pass, within), not on history).
@@ -237,7 +271,17 @@ DtpuPipeline* dtpu_pipeline_create(const uint8_t* x, const int32_t* y,
   const int64_t rows[1] = {n};
   return dtpu_pipeline_create_spans(xs, rows, 1, y, n, row_elems, batch,
                                     shuffle, seed, depth, threads, scale,
-                                    start_step, shard_index, shard_count);
+                                    start_step, shard_index, shard_count,
+                                    /*external_perms=*/0);
+}
+
+// Hand the pipeline the permutation for one pass (n int64 row indices,
+// copied). Only meaningful with external_perms; producers needing a pass
+// not yet supplied block until it arrives.
+void dtpu_pipeline_supply_perm(DtpuPipeline* p, int64_t pass,
+                               const int64_t* perm) {
+  if (!p || !perm) return;
+  p->supply_perm(pass, perm);
 }
 
 // Copies the next batch (in deterministic step order) into caller buffers of
@@ -281,8 +325,14 @@ void dtpu_pipeline_destroy(DtpuPipeline* p) {
     std::lock_guard<std::mutex> lock(p->mu);
     p->stop = true;
   }
+  {
+    // Unblock workers parked in perm_for waiting for an external pass.
+    std::lock_guard<std::mutex> lock(p->perm_mu);
+    p->perm_stop = true;
+  }
   p->cv_produce.notify_all();
   p->cv_consume.notify_all();
+  p->cv_perm.notify_all();
   for (std::thread& t : p->workers) t.join();
   delete p;
 }

@@ -1146,6 +1146,7 @@ class Model:
                 "unavailable. Serve with generate()/predict()/serving."
                 "Engine, or restore the f32 checkpoint to keep training."
             )
+        self._fit_source = None  # checkpoint saves read the live source
         if y is None:
             # Iterator mode: x yields (x_batch, y_batch) — e.g. a
             # dtpu.data.Pipeline whose native threads prefetch batches ahead
@@ -1156,6 +1157,11 @@ class Model:
                     "(e.g. distributed_tpu.data.Pipeline)"
                 )
             source = x
+            # Checkpointer/ShardedCheckpointer record this source's
+            # iterator cursor (state_dict) with every save taken during
+            # this fit — including the preemption path's final save — so
+            # mid-epoch resume can restore the stream without replay.
+            self._fit_source = source
             batch_size = getattr(source, "batch_size", batch_size)
             # A per-host-sharded source (data.Pipeline(shard=(i, P))) emits
             # only this process's rows; placement assembles the global batch.
@@ -1285,8 +1291,17 @@ class Model:
             if y is None:
                 # The array path fast-forwards via _index_stream(start_step);
                 # an iterator source must be advanced too or the resumed run
-                # retrains on already-consumed batches.
-                if hasattr(source, "seek"):
+                # retrains on already-consumed batches. Preference order:
+                # (1) the checkpoint's recorded iterator state via
+                # load_state — O(1) and LOUD about stream-identity
+                # mismatches (wrong seed/batch_size); (2) an O(1) seek to
+                # the restored step; (3) replaying a plain-but-counting
+                # iterator forward; (4) a warning.
+                data_state = getattr(self, "_restored_data_state", None)
+                self._restored_data_state = None
+                if data_state is not None and hasattr(source, "load_state"):
+                    source.load_state(data_state)
+                elif hasattr(source, "seek"):
                     source.seek(self._resumed_step)  # O(1), no batch prep
                 elif getattr(source, "steps_emitted", None) is not None:
                     for _ in range(
@@ -1527,6 +1542,11 @@ class Model:
         report["precision"] = (
             self.precision.name if self.precision is not None else None
         )
+        # Streaming-input telemetry: the decode-parallelism setting rides
+        # next to the stall fractions it exists to shrink, so a stall
+        # report names the knob to turn (docs/PERF.md "Streaming input").
+        if y is None and getattr(source, "decode_workers", None) is not None:
+            report["input_decode_workers"] = int(source.decode_workers)
         report["comm_bytes_estimate"] = self.strategy.comm_bytes_estimate(
             self.params,
             compute_dtype=(

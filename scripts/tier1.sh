@@ -89,6 +89,19 @@ if [ -n "${TIER1_ELASTIC_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_DATA_SMOKE=1: same idea for the streaming-input subsystem — runs
+# the record-shard + pipeline + file-pipeline tests and the bench input
+# smoke (~20 s) so records/decode-pool/shuffle changes iterate fast. The
+# decode-bound W-curve itself runs via `python bench.py input`. NOT a
+# tier-1 substitute.
+if [ -n "${TIER1_DATA_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_records.py \
+        tests/test_pipeline.py tests/test_file_pipeline.py \
+        "tests/test_bench.py::test_bench_input_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

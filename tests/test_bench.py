@@ -246,6 +246,22 @@ def test_bench_overlap_smoke():
     assert "d2" in out["speedup_vs_depth0"]
 
 
+def test_bench_input_smoke(tmp_path):
+    """The streaming-input mode: tiny record store, near-zero decode
+    latency, W in {0, 2} — the real decode-bound W-curve runs via
+    `python bench.py input` (BENCH_input.json)."""
+    out = bench.bench_input(batch=8, measure_steps=3, workers=(0, 2),
+                            repeats=1, n_records=64, decode_latency_ms=0.2,
+                            records_dir=str(tmp_path / "recs"))
+    assert out["decode_workers"] == 0 and out["value"] > 0
+    assert 0.0 <= out["input_stall_fraction"] <= 1.0
+    (row2,) = out["rows"]
+    assert row2["decode_workers"] == 2 and row2["value"] > 0
+    assert 0.0 <= row2["input_stall_fraction"] <= 1.0
+    assert "w2" in out["speedup_vs_w0"]
+    assert out["decode_latency_ms_per_record"] == 0.2
+
+
 def test_bench_cifar_smoke():
     out = bench.bench_cifar(global_batch=16, warmup=1, measure=2)
     assert out["value"] > 0
@@ -253,6 +269,9 @@ def test_bench_cifar_smoke():
     assert "cifar_cnn" in out["metric"]
 
 
+# @slow (tier-1 budget, PR 10): 10s smoke of an opt-in bench mode
+# (the PR 6 bench_resnet50 precedent).
+@pytest.mark.slow
 def test_bench_longctx_smoke():
     # Tiny shapes: the code path (remat variants, flop math, row shapes)
     # runs on the CPU sim; real numbers come from `python bench.py longctx`.

@@ -90,6 +90,10 @@ def test_hdf5_export_import_and_artifact(tmp_path):
     assert tree_equal(m.params, params2)
 
 
+# @slow (tier-1 budget, PR 10): 14s; the save/load mechanics are
+# covered by the other checkpoint tests — this pins the convenience
+# wrapper end-to-end.
+@pytest.mark.slow
 def test_save_load_weights_convenience(tmp_path):
     """Keras-shaped save_weights/load_weights round-trips params AND state
     (BatchNorm running stats) via HDF5 and npz, re-placing arrays under

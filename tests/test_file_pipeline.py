@@ -147,6 +147,24 @@ def test_fit_trains_from_file_pipeline(devices, tmp_path):
     assert hist.history["accuracy"][-1] > 0.9, hist.history
 
 
+def test_gather_vectorized_matches_row_at_a_time(tmp_path):
+    """The grouped-by-shard fancy-index gather is bit-identical to the
+    old per-row loop on shard-crossing, unsorted, repeated indices."""
+    d, x, _ = _make_shards(tmp_path, n=100, rows_per_shard=17)
+    src = FileSource(d)
+    rng = np.random.default_rng(3)
+    for idx in (
+        rng.integers(0, 100, 64),           # unsorted, with repeats
+        np.array([99, 0, 17, 16, 17, 50]),  # boundary rows, duplicated
+        np.array([], np.int64),             # empty gather
+        np.arange(100)[::-1],               # every row, reversed
+    ):
+        got = src.gather(idx)
+        ref = np.stack([x[i] for i in idx]) if len(idx) else got
+        np.testing.assert_array_equal(got, ref)
+        assert got.shape == (len(idx),) + src.row_shape
+
+
 def test_shards_sort_numerically(tmp_path):
     """shard-10 must follow shard-2 (lexicographic sort would reorder)."""
     d = tmp_path / "unpadded"

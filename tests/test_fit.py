@@ -44,6 +44,10 @@ def test_steps_per_epoch_semantics():
     assert model.step == 6  # 3 epochs x 2 steps, reference's 3x5 pattern
 
 
+# @slow (tier-1 budget, PR 10): 11s convergence e2e; fit-trains
+# coverage stays in-tier (pipeline/file/record fit tests, bench
+# convergence smoke).
+@pytest.mark.slow
 def test_accuracy_improves_to_high_on_separable_synthetic():
     x, y = small_data(n=1024)
     model = make_model()

@@ -171,6 +171,9 @@ def test_space_to_depth_rearranges_blocks():
         nn.SpaceToDepth(2).init(jax.random.PRNGKey(0), (5, 6, 3))
 
 
+# @slow (tier-1 budget, PR 10): 8s stem-variant training; the stem's
+# structural checks and the resnet DP training test stay in-tier.
+@pytest.mark.slow
 def test_resnet_space_to_depth_stem_trains():
     import distributed_tpu as dtpu
 

@@ -106,6 +106,72 @@ class TestPipeline:
 
 
 @pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+class TestShuffleUnification:
+    """The native and Python implementations consume ONE numpy-computed
+    per-pass permutation (the native ring receives it as an index
+    buffer), so their streams are bit-identical — the documented
+    native-vs-Python divergence is dead. DTPU_NATIVE_LEGACY_SHUFFLE=1
+    restores the old C++ splitmix order for experiments pinned to
+    pre-unification artifacts."""
+
+    def test_native_matches_python_bit_exact(self):
+        x, _ = _dataset(n=60)
+        y = np.arange(60, dtype=np.int32)
+        nat = Pipeline(x, y, 12, seed=9, use_native=True)
+        py = Pipeline(x, y, 12, seed=9, use_native=False)
+        assert nat.is_native
+        for _ in range(15):  # crosses pass boundaries (re-shuffles)
+            xa, ya = next(nat)
+            xb, yb = next(py)
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+        nat.close()
+        py.close()
+
+    def test_native_matches_python_after_seek_and_shard(self):
+        x, _ = _dataset(n=64)
+        y = np.arange(64, dtype=np.int32)
+        nat = Pipeline(x, y, 16, seed=2, shard=(1, 2), use_native=True)
+        py = Pipeline(x, y, 16, seed=2, shard=(1, 2), use_native=False)
+        nat.seek(9)
+        py.seek(9)
+        for _ in range(6):
+            xa, ya = next(nat)
+            xb, yb = next(py)
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+        nat.close()
+        py.close()
+
+    def test_legacy_env_flag_restores_old_native_order(self, monkeypatch):
+        monkeypatch.setenv("DTPU_NATIVE_LEGACY_SHUFFLE", "1")
+        x, _ = _dataset(n=60)
+        y = np.arange(60, dtype=np.int32)
+        nat = Pipeline(x, y, 12, seed=9, use_native=True)
+        py = Pipeline(x, y, 12, seed=9, use_native=False)
+        # Legacy native order is the C++ splitmix shuffle — deterministic
+        # (two legacy instances agree) but NOT the numpy order.
+        nat2 = Pipeline(x, y, 12, seed=9, use_native=True)
+        diverged = False
+        for _ in range(10):
+            xa, ya = next(nat)
+            _, ya2 = next(nat2)
+            _, yb = next(py)
+            np.testing.assert_array_equal(ya, ya2)
+            diverged = diverged or not np.array_equal(ya, yb)
+        assert diverged  # old order really is different
+        # Every pass still covers all rows exactly once.
+        nat.seek(0)
+        seen = []
+        for _ in range(5):
+            seen.extend(next(nat)[1].tolist())
+        assert sorted(seen) == list(range(60))
+        nat.close()
+        nat2.close()
+        py.close()
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
 class TestNativeSpecifics:
     def test_prefetch_deeper_than_one_pass(self):
         # depth > steps_per_pass exercises the ring wraparound + pass

@@ -294,6 +294,9 @@ def test_spark_barrier_example_executes(tmp_path, monkeypatch):
     monkeypatch.delenv("DTPU_CONFIG", raising=False)
 
 
+# @slow (tier-1 budget, PR 10): 12s sweep; representative exports
+# still execute in-tier via the other r_execution tests.
+@pytest.mark.slow
 def test_every_small_r_export_executes(tmp_path, monkeypatch):
     """Sweep the exported wrappers the examples don't touch, so EVERY
     exported R function's body has executed in CI (the examples cover the

@@ -43,7 +43,14 @@ class TestRingAttentionOp:
         ref = _dense_reference(q, k, v, causal)
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
 
-    @pytest.mark.parametrize("n_seq", [2, 4, 8])
+    # n_seq 2 and 4 @slow (tier-1 budget, PR 10): each ring width compiles
+    # its own ~9s program and the property is identical; the widest ring
+    # (8, the most schedule hops) stays in tier-1.
+    @pytest.mark.parametrize("n_seq", [
+        pytest.param(2, marks=pytest.mark.slow),
+        pytest.param(4, marks=pytest.mark.slow),
+        8,
+    ])
     def test_zigzag_matches_naive_and_dense(self, devices, n_seq):
         """The balanced causal schedule is numerically a re-association of
         the same softmax — both schedules must match dense, for even AND

@@ -106,6 +106,9 @@ def test_scanned_blocks_validations():
                   jnp.zeros((1, 8)), pos=0)
 
 
+# @slow (tier-1 budget, PR 10): 12s training e2e; forward/grad parity
+# and the LM scan-trains e2e stay in-tier.
+@pytest.mark.slow
 def test_resnet_scan_stages_trains_and_shrinks_tree():
     kw = dict(stage_blocks=(3, 3, 3, 3), width=16, small_inputs=True)
     unrolled = dtpu.models.resnet(50, 10, **kw)

@@ -64,6 +64,9 @@ def test_continuous_batching_matches_sequential_generate(lm):
     assert 0.0 < t["kv_utilization"]["peak"] <= 1.0
 
 
+# @slow (tier-1 budget, PR 10): 11s; still runs in TIER1_SERVE_SMOKE
+# (no -m filter) and with -m slow when touching prefill.
+@pytest.mark.slow
 def test_prefill_chunking_matches_whole_prompt(lm):
     """The prefill/decode split at its sharpest: a chunked prefill (chunks
     attending to earlier chunks through the pool) must equal both the

@@ -113,6 +113,9 @@ def test_timeout_labeling(tmp_path, fake_ssh):
     assert results[0].error == "timeout"
 
 
+# @slow (tier-1 budget, PR 10): 10s; the liveness-timeout mechanism
+# is pinned in-tier by test_launch.py's local variant.
+@pytest.mark.slow
 def test_liveness_timeout_over_ssh(tmp_path, fake_ssh):
     """The ssh liveness transport end-to-end: heartbeats ride stdout
     marks, a SIGSTOPped worker's stalled beat is detected within
