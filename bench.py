@@ -1656,6 +1656,179 @@ def bench_serve(num_requests=32, max_slots=8, block_size=16, vocab=512,
     }
 
 
+# ------------------------------------------------------------------ fleet --
+def bench_fleet(num_requests=64, replica_counts=(1, 2, 4), max_slots=4,
+                block_size=16, vocab=512, num_layers=4, d_model=256,
+                num_heads=8, max_len=128, prompt_range=(8, 32),
+                new_range=(32, 96), burst_size=16, burst_gap_s=0.15,
+                kill_replicas=2, kill_at_step=8, seed=0):
+    """Disaggregated serving fleet (``python bench.py fleet``, artifact
+    BENCH_fleet.json; docs/SERVING.md "Fleet"). Three pinned facts:
+
+    1. **Scaling** — aggregate useful tokens/s vs decode-replica count
+       under the SAME bursty open-loop arrival process (bursts of
+       ``burst_size`` requests every ``burst_gap_s`` fleet-seconds).
+       Asserted strictly increasing across ``replica_counts``: with the
+       queue deeper than one replica's slots, added replicas drain real
+       decode work in parallel. The prefill pool scales as ceil(R/2) so
+       prompt caching does not become the artificial bottleneck.
+    2. **Tail latency** — per-request TTFT p50/p99 from the fleet's
+       lifecycle rows. R=1 saturates (the queue builds across bursts, so
+       p99 >> p50); the same workload at the largest R shows what the
+       added replicas buy at the tail.
+    3. **Kill-a-replica** — re-runs the ``kill_replicas`` row with
+       ``FaultInjector(mode="replica_kill")`` tearing one decode replica
+       down mid-decode. Gate: ZERO lost requests and per-request outputs
+       token-exact vs the unfaulted run of the same shape (greedy
+       decode; the router requeues, survivors re-prefill).
+
+    Clock honesty (the PERF.md measured-mechanism precedent): replicas
+    are cooperative objects on one host — every dispatch is real JAX
+    compute timed for real, but each replica accrues its own VIRTUAL
+    timeline and fleet makespan is their parallel composition, which is
+    what a process-per-replica deployment computes and a 1-core box
+    cannot run for real. The artifact records the clock model; the
+    MECHANISMS (routing, handoff, requeue, autoscaling) are identical on
+    real fleets.
+    """
+    import distributed_tpu.fleet as fleet_lib
+    import distributed_tpu.serving as serving
+    from distributed_tpu.resilience import FaultInjector
+
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        vocab, num_layers=num_layers, d_model=d_model,
+        num_heads=num_heads, max_len=max_len,
+    ))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((32,))
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(0, vocab, (int(n),)).astype(np.int32)
+        for n in rng.integers(prompt_range[0], prompt_range[1] + 1,
+                              num_requests)
+    ]
+    max_news = rng.integers(new_range[0], new_range[1] + 1,
+                            num_requests).astype(int)
+    useful_tokens = int(np.sum(max_news))
+    arrivals = [
+        (i // burst_size) * burst_gap_s for i in range(num_requests)
+    ]
+
+    def requests():
+        return [serving.Request(p, int(m))
+                for p, m in zip(prompts, max_news)]
+
+    def build(r, *, fault=None, programs=None):
+        return fleet_lib.ServingFleet(
+            model, decode_replicas=r,
+            prefill_replicas=max(1, r // 2), max_slots=max_slots,
+            block_size=block_size, max_len=max_len, fault=fault,
+            programs=programs,
+        )
+
+    # Warm every program the sweep will hit (prefill buckets for fresh
+    # prompts AND for requeue-path re-prefills of prompt+generated
+    # contexts, plus the decode shape) so virtual timelines measure
+    # serving, not XLA. Long-context re-prefill is exercised by a
+    # max-length request.
+    warm = build(1)
+    long_p = rng.integers(0, vocab, (max_len - 8,)).astype(np.int32)
+    warm.run(requests()[:4] + [serving.Request(long_p, 4)])
+    programs = warm.programs
+    del warm
+
+    rows = []
+    outputs_by_r = {}
+    for r in replica_counts:
+        fl = build(int(r), programs=programs)
+        outs = fl.run(requests(), arrival_times=arrivals)
+        t = fl.last_run_telemetry
+        assert t["lost_requests"] == 0, t["lost_requests"]
+        outputs_by_r[int(r)] = [np.asarray(o) for o in outs]
+        rows.append({
+            "decode_replicas": int(r),
+            "prefill_replicas": max(1, int(r) // 2),
+            "tokens_per_sec": t["tokens_per_sec"],
+            "makespan_s": t["makespan_s"],
+            "ttft_mean_s": t["time_to_first_token"]["mean"],
+            "ttft_p50_s": t["time_to_first_token"]["p50"],
+            "ttft_p99_s": t["time_to_first_token"]["p99"],
+            "queue_depth_peak": t["queue_depth_peak"],
+            "handoffs_installed": t["handoffs"]["installed"],
+            "decode_steps": t["decode_steps"],
+            "preemptions": t["preemptions"],
+        })
+    for prev, cur in zip(rows, rows[1:]):
+        assert cur["tokens_per_sec"] > prev["tokens_per_sec"], (
+            f"aggregate tokens/s must increase with decode replicas: "
+            f"{[r['tokens_per_sec'] for r in rows]}"
+        )
+    base = rows[0]["tokens_per_sec"]
+    for row in rows:
+        row["speedup_vs_r1"] = round(row["tokens_per_sec"] / base, 2)
+
+    # Kill-a-replica: same workload/shape as the kill_replicas row,
+    # one decode replica torn down mid-decode; the reconcile loop
+    # respawns capacity and the router requeues the dead replica's
+    # in-flight work.
+    fault = FaultInjector("replica_kill", replica="decode-1",
+                          at_step=kill_at_step)
+    fk = build(int(kill_replicas), fault=fault, programs=programs)
+    kouts = fk.run(requests(), arrival_times=arrivals)
+    kt = fk.last_run_telemetry
+    ref = outputs_by_r[int(kill_replicas)]
+    token_exact = all(
+        np.array_equal(a, b) for a, b in zip(ref, kouts)
+    )
+    assert kt["lost_requests"] == 0, kt["lost_requests"]
+    assert len(kt["decode_pool"]["kills"]) == 1, kt["decode_pool"]["kills"]
+    assert token_exact, "kill-recovery outputs diverged from unfaulted run"
+    kill_row = {
+        "decode_replicas": int(kill_replicas),
+        "killed_replica": kt["decode_pool"]["kills"][0]["replica"],
+        "kill_at_decode_step": kill_at_step,
+        "requeued_requests": kt["decode_pool"]["kills"][0]["requeued"],
+        "lost_requests": kt["lost_requests"],
+        "token_exact_vs_unfaulted": bool(token_exact),
+        "tokens_per_sec": kt["tokens_per_sec"],
+        "ttft_p99_s": kt["time_to_first_token"]["p99"],
+        "fallback_reprefills": kt["handoffs"]["fallback_reprefill"],
+        "respawned": any(
+            e["event"] == "spawn" for e in kt["decode_pool"]["events"]
+        ),
+    }
+
+    top = rows[-1]
+    return {
+        "metric": f"fleet_aggregate_tokens_per_sec_r{top['decode_replicas']}",
+        "value": top["tokens_per_sec"],
+        "unit": "tokens/s",
+        "speedup_vs_one_replica": top["speedup_vs_r1"],
+        "ttft_p50_s": top["ttft_p50_s"],
+        "ttft_p99_s": top["ttft_p99_s"],
+        "scaling": rows,
+        "kill": kill_row,
+        "arrivals": {
+            "process": "bursty open-loop",
+            "num_requests": num_requests,
+            "burst_size": burst_size,
+            "burst_gap_s": burst_gap_s,
+            "useful_tokens": useful_tokens,
+        },
+        "clock": "virtual: per-replica timelines over real dispatch "
+                 "walls (single-host harness; docs/SERVING.md 'Fleet')",
+        "spinup_alloc_s": kt["decode_pool"]["spinup_alloc_s"],
+        "workload": {
+            "max_slots": max_slots,
+            "block_size": block_size,
+            "prompt_range": list(prompt_range),
+            "new_range": list(new_range),
+            "model": f"lm_l{num_layers}_d{d_model}_v{vocab}",
+        },
+    }
+
+
 # ------------------------------------------------------------------ quant --
 def bench_quant(vocab=512, num_layers=4, d_model=256, num_heads=8,
                 max_len=128, probe_batch=8, probe_len=32, seed=0):
@@ -2050,7 +2223,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
     known = {"mnist", "multistep", "overlap", "input", "convergence",
              "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
              "precision", "compile_cache", "serve", "elastic", "quant",
-             "fused_update", "autoshard"}
+             "fused_update", "autoshard", "fleet"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -2096,6 +2269,12 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # Opt-in: continuous batching + paged KV serving vs static-batch
         # generate() (BENCH_serve.json; docs/SERVING.md).
         extra.append(bench_serve())
+    if "fleet" in modes:
+        # Opt-in: disaggregated prefill/decode fleet — tokens/s scaling
+        # vs replica count, tail TTFT under bursty arrivals, and the
+        # kill-a-replica recovery row (BENCH_fleet.json;
+        # docs/SERVING.md "Fleet").
+        extra.append(bench_fleet())
     if "elastic" in modes:
         # Opt-in: elastic gang 4->2->4 resize-to-first-step latency
         # (BENCH_elastic.json; docs/RESILIENCE.md "Elastic gangs").

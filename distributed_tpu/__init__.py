@@ -60,10 +60,12 @@ def __getattr__(name):
     # base import cost on CPU boxes. quant itself is light (jnp only) and
     # usually already bound by nn's layer imports; the Pallas machinery
     # stays behind ops.__getattr__ until an API that needs it is called.
-    if name == "quant":
+    if name in ("quant", "fleet"):
+        # fleet (the multi-replica serving tier) is lazy for the same
+        # reason: training-only processes never pay for it.
         import importlib
 
-        return importlib.import_module(".quant", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
@@ -102,6 +104,7 @@ __all__ = [
     "callbacks",
     "resilience",
     "serving",
+    "fleet",  # lazy: see __getattr__
     "quant",  # lazy: see __getattr__
     "__version__",
 ]

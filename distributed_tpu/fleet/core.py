@@ -1,0 +1,533 @@
+"""The serving fleet: disaggregated prefill/decode pools behind a router.
+
+``ServingFleet`` composes the pieces this package and its neighbors
+provide into one serving tier:
+
+- a :class:`~distributed_tpu.fleet.router.Router` at the front (bounded
+  queue, SLO admission, weighted per-tenant fairness);
+- a pool of :class:`~distributed_tpu.fleet.replica.PrefillReplica` that
+  turn prompts into first tokens + KV payloads
+  (``fleet.handoff``), and a pool of
+  :class:`~distributed_tpu.fleet.replica.DecodeReplica` that decode them
+  to completion — prefill/decode DISAGGREGATION, the intra-engine split
+  of ``serving.Engine`` promoted to an inter-replica one;
+- a :class:`~distributed_tpu.fleet.autoscale.QueueAutoscaler` (optional)
+  driving the decode-pool size from queue depth / tail latency through
+  the same reconcile step that replaces killed replicas;
+- a :class:`~distributed_tpu.resilience.FaultInjector` hook
+  (``mode="replica_kill"``) so replica death mid-request is a provable,
+  benchable event: the dead replica's in-flight sequences re-queue at
+  the router and finish on surviving replicas, token-exact under greedy
+  (the scheduler's preemption-requeue contract across replicas).
+
+**The clock.** Replicas are cooperative objects in one process; the fleet
+drives them with a discrete-event loop over a VIRTUAL clock: every device
+dispatch is real JAX work timed for real, but its wall time advances only
+the owning replica's timeline (``busy_until``), and fleet time jumps to
+the next event (arrival, replica free, spin-up done). Tokens, scheduling
+decisions, and failure handling are therefore exactly what a process-per-
+replica deployment computes, while throughput/latency numbers describe
+the fleet as if replicas ran in parallel — which one 1-core host cannot
+do for real. Artifacts and docs state this honestly (the PERF.md
+measured-mechanism precedent); on a real multi-host deployment the same
+control logic runs against wall clocks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence as SequenceT
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..serving.scheduler import Request, Sequence
+from ..utils import events as events_lib
+from .autoscale import QueueAutoscaler
+from .replica import DecodeReplica, EnginePrograms, PrefillReplica
+from .router import Router
+
+__all__ = ["ServingFleet", "FleetResult"]
+
+
+class FleetResult(list):
+    """The per-request outputs (submission order; ``None`` for rejected
+    requests) with the run's telemetry attached as ``.telemetry``."""
+
+    telemetry: dict
+
+
+class ServingFleet:
+    """See module docstring.
+
+    ``transfer="blocks"`` moves prefilled KV to the decode replica via
+    the handoff payload; ``transfer="none"`` models a deployment without
+    a transfer path — the decode replica re-prefills every context (the
+    documented fallback; same tokens, more compute). ``prefill_replicas=0``
+    colocates prefill on the decode replicas (the engine's own layout).
+    """
+
+    def __init__(self, model, *, decode_replicas: int = 2,
+                 prefill_replicas: int = 1, max_slots: int = 4,
+                 block_size: int = 16, max_len: int = 128,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 transfer: str = "blocks",
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 router: Optional[Router] = None,
+                 autoscaler: Optional[QueueAutoscaler] = None,
+                 fault=None,
+                 programs: Optional[EnginePrograms] = None):
+        if decode_replicas < 1:
+            raise ValueError(
+                f"decode_replicas must be >= 1, got {decode_replicas}"
+            )
+        if prefill_replicas < 0:
+            raise ValueError(
+                f"prefill_replicas must be >= 0, got {prefill_replicas}"
+            )
+        if transfer not in ("blocks", "none"):
+            raise ValueError(
+                f"transfer must be 'blocks' or 'none', got {transfer!r}"
+            )
+        self.model = model
+        self.programs = programs or EnginePrograms(
+            model, temperature=temperature, top_k=top_k, seed=seed
+        )
+        # Positional-capacity check up front, exactly like Engine: a
+        # too-short learned positional table must fail HERE, not clamp
+        # rows mid-serve on some replica.
+        jax.eval_shape(
+            lambda p: model.module.init_cache(p, 1, int(max_len),
+                                              jnp.float32),
+            model.params,
+        )
+        self.max_slots = int(max_slots)
+        self.block_size = int(block_size)
+        self.max_len = int(max_len)
+        self.num_blocks = num_blocks
+        self.prefill_chunk = prefill_chunk
+        self.transfer = transfer
+        self.eos_id = eos_id
+        self.router = router or Router()
+        self.autoscaler = autoscaler
+        self.fault = fault
+        self._ids = itertools.count()
+        self._configured_decode = int(decode_replicas)
+        self.decode_pool: Dict[str, DecodeReplica] = {}
+        self._warming: Dict[str, float] = {}  # name -> ready_at
+        self.prefill_pool: List[PrefillReplica] = [
+            PrefillReplica(
+                f"prefill-{i}", self.programs,
+                block_size=self.block_size, max_len=self.max_len,
+                prefill_chunk=self.prefill_chunk,
+            )
+            for i in range(int(prefill_replicas))
+        ]
+        self.pool_events: List[dict] = []
+        self._retired_rows: Dict[str, dict] = {}  # stats outlive retirement
+        self.spinup_measured_s = 0.0
+        for _ in range(int(decode_replicas)):
+            self._spawn(0.0, warm=False)
+        self.last_run_telemetry: Optional[dict] = None
+
+    # ----------------------------------------------------------- replicas
+    def _spawn(self, now: float, *, warm: bool = True) -> DecodeReplica:
+        """Add a decode replica. Pool allocation is timed for real and,
+        together with the autoscaler's modeled ``spinup_s``, delays when
+        the replica takes work — programs are shared, so spin-up never
+        re-traces (the warm-compile-cache contract)."""
+        name = f"decode-{next(self._ids)}"
+        t0 = time.perf_counter()
+        rep = DecodeReplica(
+            name, self.programs, max_slots=self.max_slots,
+            block_size=self.block_size, max_len=self.max_len,
+            num_blocks=self.num_blocks, prefill_chunk=self.prefill_chunk,
+            eos_id=self.eos_id,
+        )
+        alloc = time.perf_counter() - t0
+        self.spinup_measured_s = max(self.spinup_measured_s, alloc)
+        self.decode_pool[name] = rep
+        if warm:
+            extra = self.autoscaler.spinup_s if self.autoscaler else 0.0
+            ready = now + alloc + extra
+            self._warming[name] = ready
+            rep.busy_until = ready
+            self.pool_events.append({
+                "t": round(now, 4), "event": "spawn", "replica": name,
+                "ready_at": round(ready, 4),
+            })
+        return rep
+
+    @staticmethod
+    def _replica_row(rep: DecodeReplica) -> dict:
+        return {
+            "decode_steps": rep.decode_steps,
+            "prefill_dispatches": rep.prefill_dispatches,
+            "preemptions": rep.preemptions,
+            "handoffs_installed": rep.handoffs_installed,
+            "handoffs_fallback": rep.handoffs_fallback,
+            "busy_s": round(rep.busy_s, 4),
+            "alive": rep.alive,
+        }
+
+    def _retire(self, name: str, now: float) -> None:
+        rep = self.decode_pool.pop(name)
+        self._retired_rows[name] = self._replica_row(rep)
+        self._warming.pop(name, None)
+        self.pool_events.append({
+            "t": round(now, 4), "event": "retire", "replica": name,
+        })
+
+    def _ready(self, rep: DecodeReplica, now: float) -> bool:
+        return rep.alive and self._warming.get(rep.name, 0.0) <= now
+
+    def _reconcile(self, now: float) -> bool:
+        """Drive the live decode-pool size toward the target — the
+        autoscaler's if present, else the configured count. One reconcile
+        step serves BOTH elasticity and healing: a killed replica leaves
+        the pool below target and the next pass replaces it."""
+        target = (self.autoscaler.target if self.autoscaler
+                  else self._configured_decode)
+        changed = False
+        while len(self.decode_pool) < target:
+            self._spawn(now)
+            changed = True
+        if len(self.decode_pool) > target:
+            # Shrink only drains: retire an idle replica; if none is
+            # idle, keep serving and try again at the next event.
+            for name, rep in sorted(self.decode_pool.items()):
+                if self._ready(rep, now) and rep.in_flight == 0:
+                    self._retire(name, now)
+                    changed = True
+                    break
+        return changed
+
+    # ---------------------------------------------------------------- run
+    def run(self, requests: SequenceT, *,
+            arrival_times: Optional[SequenceT] = None,
+            tenants: Optional[SequenceT] = None) -> FleetResult:
+        """Serve ``requests`` (``serving.Request`` or (prompt, n) pairs)
+        under an open-loop arrival process: request i becomes visible to
+        the router at ``arrival_times[i]`` (fleet seconds; default all
+        0.0) with tenant ``tenants[i]`` (default "default"). Returns
+        outputs in submission order (``None`` where admission rejected);
+        telemetry lands in ``fleet.last_run_telemetry`` and on the
+        result's ``.telemetry``."""
+        reqs = [
+            r if isinstance(r, Request) else Request(r[0], r[1])
+            for r in requests
+        ]
+        for r in reqs:
+            need = r.prompt.size + r.max_new_tokens
+            if need > self.max_len:
+                raise ValueError(
+                    f"request {r.request_id}: prompt {r.prompt.size} + "
+                    f"max_new_tokens {r.max_new_tokens} exceeds fleet "
+                    f"max_len {self.max_len}"
+                )
+        times = [0.0] * len(reqs) if arrival_times is None else [
+            float(t) for t in arrival_times
+        ]
+        tens = ["default"] * len(reqs) if tenants is None else list(tenants)
+        if len(times) != len(reqs) or len(tens) != len(reqs):
+            raise ValueError(
+                "arrival_times/tenants must match requests in length"
+            )
+        arrivals = sorted(
+            zip(times, range(len(reqs)), reqs, tens), key=lambda a: a[:2]
+        )
+        ai = 0
+        now = 0.0
+        wall0 = time.perf_counter()
+        results: Dict[int, np.ndarray] = {}
+        admitted: Dict[int, Sequence] = {}
+        seqs_in_order: List[Optional[Sequence]] = [None] * len(reqs)
+        head: Optional[Sequence] = None  # popped from router, unplaced
+        pending_handoff: List[list] = []  # [ready_at, seq, payload]
+        kills: List[dict] = []
+        fallback_dispatches = 0  # re-prefills: transfer off / replica lost
+        queue_peak = 0
+        ttft_recent: List[float] = []
+
+        def record_finish(seq: Sequence):
+            results[seq.request.request_id] = seq.output()
+            self.router.observe_finish(seq.finished_at)
+            ttft_recent.append(seq.first_token_at - seq.submitted_at)
+            del ttft_recent[:-64]
+
+        while True:
+            progressed = False
+            # -- arrivals due now --------------------------------------
+            while ai < len(arrivals) and arrivals[ai][0] <= now:
+                t, i, req, tenant = arrivals[ai]
+                ai += 1
+                adm, seq = self.router.submit(req, tenant=tenant, now=t)
+                if adm.accepted:
+                    admitted[req.request_id] = seq
+                    seqs_in_order[i] = seq
+                progressed = True
+            # -- fault injection: replica-addressable kills ------------
+            if self.fault is not None:
+                for name, rep in sorted(self.decode_pool.items()):
+                    if not rep.alive:
+                        continue
+                    if self.fault.should_kill_replica(name,
+                                                      rep.decode_steps):
+                        lost = rep.kill(now)
+                        self._retire(name, now)
+                        self.router.requeue(lost, now)
+                        kills.append({
+                            "t": round(now, 4), "replica": name,
+                            "requeued": len(lost),
+                            "decode_steps": rep.decode_steps,
+                        })
+                        events_lib.emit(
+                            "fleet_replica_killed", replica=name,
+                            requeued=len(lost),
+                        )
+                        progressed = True
+            # -- autoscaling + pool reconcile --------------------------
+            if self.autoscaler is not None:
+                live = [
+                    r for r in self.decode_pool.values() if r.alive
+                ]
+                qd = self.router.queue_depth + sum(
+                    r.queue_depth for r in live
+                ) + (1 if head is not None else 0)
+                p99 = (
+                    float(np.percentile(ttft_recent, 99))
+                    if ttft_recent else None
+                )
+                self.autoscaler.decide(
+                    now, queue_depth=qd, replicas=max(len(live), 1),
+                    free_slots=sum(
+                        r.free_slots for r in live
+                        if self._ready(r, now)
+                    ),
+                    slots_per_replica=self.max_slots,
+                    recent_p99_ttft=p99,
+                )
+            if self._reconcile(now):
+                progressed = True
+            # -- prefill completions -> decode dispatch queue ----------
+            # (Extraction alone is not progress: an item that fails to
+            # place goes straight back, and claiming progress for the
+            # round-trip would busy-spin the loop at a stuck `now`.)
+            due = [p for p in pending_handoff if p[0] <= now]
+            pending_handoff[:] = [
+                p for p in pending_handoff if p[0] > now
+            ]
+            # -- route work --------------------------------------------
+            # head buffer: at most one popped-but-unplaced sequence, so
+            # WFQ order is preserved while a full pool applies
+            # backpressure instead of dropping the pop.
+            dispatchable = due
+            while True:
+                if head is None:
+                    head = self.router.next_request()
+                if head is None:
+                    break
+                seq = head
+                fresh = seq.num_generated == 0
+                idle_prefill = next(
+                    (p for p in self.prefill_pool
+                     if p.busy_until <= now), None
+                ) if (fresh and self.prefill_pool) else None
+                if fresh and self.prefill_pool:
+                    if idle_prefill is None:
+                        break  # prefill pool busy: arrivals wait here
+                    dt, payload = idle_prefill.prefill(seq)
+                    idle_prefill.busy_until = now + dt
+                    if seq.first_token_at is None:
+                        seq.first_token_at = now + dt
+                    if seq.finished or seq.last_token == self.eos_id:
+                        seq.finished_at = now + dt
+                        record_finish(seq)
+                    else:
+                        pending_handoff.append([
+                            now + dt, seq,
+                            payload if self.transfer == "blocks" else None,
+                        ])
+                    head = None
+                    progressed = True
+                    continue
+                # straight to decode: requeued sequences, and fresh ones
+                # when no prefill pool exists (colocated layout).
+                dispatchable.append([now, seq, None])
+                head = None
+                progressed = True
+            for item in dispatchable:
+                _, seq, payload = item
+                target = min(
+                    (r for r in self.decode_pool.values()
+                     if self._ready(r, now) and r.free_slots > 0),
+                    key=lambda r: (r.in_flight, r.name), default=None,
+                )
+                if target is None:
+                    # No capacity: hold as pending, re-offered next pass.
+                    pending_handoff.append([now, seq, payload])
+                    continue
+                if payload is None and seq.num_generated > 0:
+                    # Prefilled (or partially decoded) elsewhere but the
+                    # KV could not travel: the decode side re-prefills.
+                    fallback_dispatches += 1
+                target.submit(seq, now, payload=payload)
+                seq.replica = target.name
+                progressed = True
+            # -- step replicas on their own timelines ------------------
+            for name, rep in sorted(self.decode_pool.items()):
+                if not self._ready(rep, now) or rep.busy_until > now:
+                    continue
+                if not rep.has_work:
+                    continue
+                dt, finished = rep.step(now)
+                rep.busy_until = now + dt
+                for seq in finished:
+                    record_finish(seq)
+                progressed = True
+            queue_peak = max(
+                queue_peak,
+                self.router.queue_depth + sum(
+                    r.queue_depth for r in self.decode_pool.values()
+                ) + (1 if head is not None else 0) + len(pending_handoff),
+            )
+            if progressed:
+                continue
+            # -- advance the clock to the next event -------------------
+            horizon = []
+            if ai < len(arrivals):
+                horizon.append(arrivals[ai][0])
+            horizon += [p[0] for p in pending_handoff]
+            horizon += [
+                r.busy_until for r in self.decode_pool.values()
+                if r.busy_until > now and (r.has_work or not self._ready(
+                    r, now))
+            ]
+            horizon += [
+                p.busy_until for p in self.prefill_pool
+                if p.busy_until > now
+            ]
+            horizon += [
+                t for t in self._warming.values() if t > now
+            ]
+            future = [t for t in horizon if t > now]
+            outstanding = (
+                head is not None or pending_handoff
+                or self.router.queue_depth
+                or any(r.has_work for r in self.decode_pool.values())
+                or ai < len(arrivals)
+            )
+            if not outstanding:
+                break
+            if not future:
+                raise RuntimeError(
+                    "fleet deadlock: "
+                    f"{len(admitted) - len(results)} request(s) cannot be "
+                    "placed — decode pool too small for the workload "
+                    "(raise num_blocks/max_slots or add replicas)"
+                )
+            now = min(future)
+
+        self._finalize_telemetry(
+            reqs, seqs_in_order, admitted, results, kills, queue_peak,
+            fallback_dispatches, wall_s=time.perf_counter() - wall0,
+        )
+        out = FleetResult(
+            results.get(r.request_id) for r in reqs
+        )
+        out.telemetry = self.last_run_telemetry
+        return out
+
+    # ----------------------------------------------------------- telemetry
+    def _finalize_telemetry(self, reqs, seqs_in_order, admitted, results,
+                            kills, queue_peak, fallback_dispatches,
+                            wall_s):
+        fins = [s for s in admitted.values()
+                if s.request.request_id in results]
+        ttfts = [s.first_token_at - s.submitted_at for s in fins]
+        makespan = max((s.finished_at for s in fins), default=0.0)
+        useful = int(sum(
+            len(results[s.request.request_id]) - s.prompt_len
+            for s in fins
+        ))
+        rows = dict(self._retired_rows)
+        rows.update({
+            n: self._replica_row(r)
+            for n, r in sorted(self.decode_pool.items())
+        })
+        tel = {
+            "clock": "virtual (per-replica timelines over real dispatch "
+                     "walls; single-host harness — see docs/SERVING.md "
+                     "'Fleet')",
+            "requests_submitted": len(reqs),
+            "requests_admitted": len(admitted),
+            "requests_finished": len(results),
+            "lost_requests": len(admitted) - len(results),
+            "generated_tokens": useful,
+            "makespan_s": round(float(makespan), 4),
+            "wall_s": round(float(wall_s), 4),
+            "tokens_per_sec": round(useful / makespan, 3)
+            if makespan > 0 else 0.0,
+            "time_to_first_token": {
+                "mean": round(float(np.mean(ttfts)), 4) if ttfts else None,
+                "p50": round(float(np.percentile(ttfts, 50)), 4)
+                if ttfts else None,
+                "p99": round(float(np.percentile(ttfts, 99)), 4)
+                if ttfts else None,
+                "max": round(float(np.max(ttfts)), 4) if ttfts else None,
+            },
+            "requests": [
+                None if s is None else {
+                    "request_id": s.request.request_id,
+                    "tenant": getattr(s, "tenant", "default"),
+                    "replica": getattr(s, "replica", None),
+                    "enqueued_s": round(float(s.submitted_at), 4),
+                    "admitted_s": round(float(s.admitted_at), 4)
+                    if s.admitted_at is not None else None,
+                    "first_token_s": round(float(s.first_token_at), 4)
+                    if s.first_token_at is not None else None,
+                    "finished_s": round(float(s.finished_at), 4)
+                    if s.finished_at is not None else None,
+                    "requeues": getattr(s, "requeues", 0),
+                    "preemptions": s.preemptions,
+                }
+                for s in seqs_in_order
+            ],
+            "router": self.router.telemetry(),
+            "queue_depth_peak": int(queue_peak),
+            "decode_pool": {
+                "final_replicas": len(self.decode_pool),
+                "replicas": rows,
+                "events": list(self.pool_events),
+                "kills": kills,
+                "spinup_alloc_s": round(self.spinup_measured_s, 4),
+            },
+            "prefill_pool": {
+                "replicas": len(self.prefill_pool),
+                "prefills": sum(p.prefills for p in self.prefill_pool),
+                "busy_s": round(
+                    sum(p.busy_s for p in self.prefill_pool), 4
+                ),
+            },
+            "handoffs": {
+                "transfer": self.transfer,
+                "installed": sum(
+                    r["handoffs_installed"] for r in rows.values()
+                ),
+                "fallback_reprefill": fallback_dispatches + sum(
+                    r["handoffs_fallback"] for r in rows.values()
+                ),
+            },
+            "preemptions": sum(r["preemptions"] for r in rows.values()),
+            "decode_steps": sum(r["decode_steps"] for r in rows.values()),
+        }
+        if self.autoscaler is not None:
+            tel["autoscaler"] = {
+                "target": self.autoscaler.target,
+                "events": list(self.autoscaler.events),
+            }
+        self.last_run_telemetry = tel

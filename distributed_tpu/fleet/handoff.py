@@ -1,0 +1,148 @@
+"""Prefill→decode KV-block handoff (the disaggregation contract).
+
+A prefill replica computes a request's prompt KV into its OWN paged pool;
+the decode replica that will run the request owns a DIFFERENT pool with
+different free blocks. The handoff payload is the bridge: the sequence's
+blocks, gathered to host in logical order and keyed by the
+``ShardedCheckpointer`` block-layout idiom — ``<leaf-path>@<starts>@<shape>``
+(``checkpoint.sharded._block_key``), where ``starts`` is the LOGICAL block
+offset of the run within the sequence, not a pool index. Pool block ids are
+deliberately absent from the payload: they are placement, and placement is
+the receiver's business — exactly how the sharded checkpoint's restore
+rebuilds a leaf under the *current* mesh from blocks keyed by global
+offsets. The decode side scatters each run into whatever blocks its own
+allocator granted.
+
+When transfer is unavailable (``ServingFleet(transfer="none")``), or the
+pools disagree on block size / dtype / layer structure,
+:func:`install_kv` raises :class:`HandoffIncompatible` and the fleet falls
+back to RE-PREFILLING the context on the decode replica — the scheduler's
+preemption-requeue semantics (token-exact under greedy), paid as recompute
+instead of transfer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint.core import iter_leaf_paths
+from ..checkpoint.sharded import _block_key, _parse_key
+
+__all__ = ["KVHandoff", "HandoffIncompatible", "pack_kv", "install_kv"]
+
+
+class HandoffIncompatible(ValueError):
+    """The payload cannot be installed into this pool (block size, dtype,
+    or layer-structure mismatch) — the caller must re-prefill instead."""
+
+
+def _cache_leaves(caches):
+    """(path, leaf) pairs of the paged pools in checkpoint path order,
+    plus the flatten structure for rebuilds. iter_leaf_paths (sorted dict
+    keys, '#i' list entries) and jax's tree_flatten agree on ordering for
+    the cache containers (dicts/lists/tuples), asserted here so a future
+    container type cannot silently misalign a scatter."""
+    paths = [p for p, _ in iter_leaf_paths(caches)]
+    leaves, treedef = jax.tree_util.tree_flatten(caches)
+    if len(paths) != len(leaves):
+        raise AssertionError(
+            f"cache path walk found {len(paths)} leaves but tree_flatten "
+            f"found {len(leaves)} — container ordering mismatch"
+        )
+    return paths, leaves, treedef
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One sequence's cached KV, detached from any pool.
+
+    ``blocks`` maps ``<leaf-path>@<logical-block-start>@<shape>`` to a host
+    array of shape ``(n_blocks, block_size, ...)`` — the sequence's blocks
+    for that attention layer, in logical order. ``cached_len`` is the
+    number of POSITIONS cached (the prefilled context; the first generated
+    token's KV is NOT included — its row is written by the receiver's
+    first decode step, mirroring the engine's post-prefill state)."""
+
+    blocks: Dict[str, np.ndarray]
+    cached_len: int
+    block_size: int
+    dtype: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.blocks.values()))
+
+
+def pack_kv(kv, slot: int, cached_len: int) -> KVHandoff:
+    """Gather ``slot``'s first ``blocks_for(cached_len)`` blocks out of
+    every layer pool into one host payload. One fancy-index gather per
+    layer leaf; block ids never leave the owning pool."""
+    n = kv.blocks_for(cached_len)
+    ids = np.asarray(kv._slot_blocks[slot][:n], np.int32)
+    if len(ids) < n:
+        raise ValueError(
+            f"slot {slot} owns {len(ids)} blocks but {n} are needed to "
+            f"cover {cached_len} cached positions"
+        )
+    paths, leaves, _ = _cache_leaves(kv.caches)
+    blocks = {}
+    dtype = None
+    for path, pool in zip(paths, leaves):
+        data = np.asarray(jax.device_get(pool[ids]))
+        dtype = str(pool.dtype)
+        blocks[_block_key(path, (0,) * data.ndim, data.shape)] = data
+    return KVHandoff(blocks=blocks, cached_len=int(cached_len),
+                     block_size=int(kv.block_size), dtype=dtype or "")
+
+
+def install_kv(kv, slot: int, payload: KVHandoff):
+    """Scatter ``payload`` into ``slot``'s already-reserved blocks of this
+    pool (reserve first: the engine's admission path grants the blocks).
+    Raises :class:`HandoffIncompatible` when the pools disagree — the
+    caller then re-prefills. Returns the number of blocks installed."""
+    if payload.block_size != kv.block_size:
+        raise HandoffIncompatible(
+            f"block_size mismatch: payload {payload.block_size} vs pool "
+            f"{kv.block_size}"
+        )
+    need = kv.blocks_for(payload.cached_len)
+    ids = kv._slot_blocks[slot]
+    if len(ids) < need:
+        raise ValueError(
+            f"slot {slot} has {len(ids)} reserved blocks but the payload "
+            f"covers {need} — reserve the sequence's context first"
+        )
+    paths, leaves, treedef = _cache_leaves(kv.caches)
+    by_path: Dict[str, list] = {}
+    for key, data in payload.blocks.items():
+        path, starts, _shape = _parse_key(key)
+        by_path.setdefault(path, []).append((starts[0] if starts else 0,
+                                             data))
+    if set(by_path) != set(paths):
+        raise HandoffIncompatible(
+            "layer structure mismatch between prefill and decode pools "
+            f"(payload layers {sorted(by_path)[:3]}... vs pool "
+            f"{sorted(paths)[:3]}...)"
+        )
+    installed = 0
+    new_leaves = []
+    for path, pool in zip(paths, leaves):
+        if str(pool.dtype) != payload.dtype:
+            raise HandoffIncompatible(
+                f"dtype mismatch on {path}: payload {payload.dtype} vs "
+                f"pool {pool.dtype}"
+            )
+        for start, data in sorted(by_path[path]):
+            run = np.asarray(ids[start:start + data.shape[0]], np.int32)
+            pool = pool.at[jnp.asarray(run)].set(
+                jnp.asarray(data, pool.dtype)
+            )
+            installed += int(data.shape[0])
+        new_leaves.append(pool)
+    kv.caches = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return installed

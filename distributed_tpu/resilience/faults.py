@@ -16,6 +16,12 @@ worker fail in a chosen mode at a chosen step, once:
   hung-in-kernel.
 - ``corrupt_checkpoint``: clobber the newest checkpoint file, then die —
   exercising restore's fall-back-to-previous-step path.
+- ``replica_kill``: address a NAMED serving-fleet pool member (e.g.
+  ``replica="decode-1"``) instead of a process rank. The fleet polls
+  :meth:`FaultInjector.should_kill_replica` at its step boundaries and
+  tears that replica down mid-request — the failure the router's
+  requeue path exists for (docs/SERVING.md "Fleet"). Fleet-driven, not
+  training-driven: ``on_batch_end`` ignores this mode.
 
 ``once_marker`` (a file path) arms the fault for the FIRST attempt only:
 the restarted worker sees the marker and trains through — exactly the
@@ -39,7 +45,8 @@ from ..utils import events as events_lib
 ENV_VAR = "DTPU_FAULT"
 MARKER_ENV_VAR = "DTPU_FAULT_MARKER"
 
-MODES = ("kill", "hang", "slow_heartbeat", "corrupt_checkpoint")
+MODES = ("kill", "hang", "slow_heartbeat", "corrupt_checkpoint",
+         "replica_kill")
 
 
 def corrupt_latest_checkpoint(directory) -> Optional[Path]:
@@ -73,13 +80,18 @@ class FaultInjector(Callback):
     def __init__(self, mode: str, *, at_step: int = 5,
                  rank: Optional[int] = 0, once_marker=None,
                  exit_code: int = 17, hang_seconds: float = 10_000.0,
-                 directory=None):
+                 directory=None, replica: Optional[str] = None):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
         if mode == "corrupt_checkpoint" and directory is None:
             raise ValueError(
                 "corrupt_checkpoint mode needs directory= (the checkpoint "
                 "dir whose newest file gets clobbered)"
+            )
+        if mode == "replica_kill" and not replica:
+            raise ValueError(
+                "replica_kill mode needs replica= (the pool-member name, "
+                "e.g. 'decode-1', that the fleet should tear down)"
             )
         self.mode = mode
         self.at_step = int(at_step)
@@ -88,13 +100,14 @@ class FaultInjector(Callback):
         self.exit_code = int(exit_code)
         self.hang_seconds = float(hang_seconds)
         self.directory = directory
+        self.replica = replica
         self.fired = False
 
     @classmethod
     def from_env(cls) -> Optional["FaultInjector"]:
         """Build from ``DTPU_FAULT`` ("mode" or "mode:key=val,key=val";
         keys: at_step, rank [int or 'all'], exit_code, hang_seconds,
-        directory) and ``DTPU_FAULT_MARKER`` (once-only arming). Returns
+        directory, replica) and ``DTPU_FAULT_MARKER`` (once-only arming). Returns
         None when the variable is unset — scripts can unconditionally
         append ``*filter(None, [FaultInjector.from_env()])``."""
         spec = os.environ.get(ENV_VAR)
@@ -111,7 +124,7 @@ class FaultInjector(Callback):
                 kw[key] = None if val == "all" else int(val)
             elif key == "hang_seconds":
                 kw[key] = float(val)
-            elif key == "directory":
+            elif key in ("directory", "replica"):
                 kw[key] = val
             else:
                 raise ValueError(f"unknown {ENV_VAR} key {key!r} in {spec!r}")
@@ -132,7 +145,31 @@ class FaultInjector(Callback):
                 return False
         return True
 
+    def should_kill_replica(self, name: str, step: int) -> bool:
+        """Fleet-facing trigger: True exactly once, when ``name`` matches
+        the armed ``replica`` target and ``step`` (the fleet's decode-step
+        counter for that replica) has reached ``at_step`` — same ``>=``
+        comparison and once-marker semantics as the process faults, so a
+        marker left by a previous run keeps the fault disarmed. The fleet
+        polls this at its step boundaries; process-rank gating does not
+        apply (the fleet addresses replicas by name, not rank)."""
+        if self.mode != "replica_kill" or name != self.replica:
+            return False
+        if step < self.at_step or self.fired:
+            return False
+        if self.once_marker is not None and self.once_marker.exists():
+            return False
+        self.fired = True
+        if self.once_marker is not None:
+            self.once_marker.parent.mkdir(parents=True, exist_ok=True)
+            self.once_marker.touch()
+        events_lib.emit("fault_injected", mode=self.mode, step=int(step),
+                        replica=name)
+        return True
+
     def on_batch_end(self, model, step, logs):
+        if self.mode == "replica_kill":
+            return  # fleet-driven (should_kill_replica), not training-driven
         if step < self.at_step or not self._armed():
             return
         self.fired = True

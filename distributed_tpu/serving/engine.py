@@ -165,6 +165,21 @@ class Engine:
             donate_argnums=(2,),
         ))
         self.last_run_telemetry = None
+        self._sched: Optional[Scheduler] = None  # live during run()
+
+    # ------------------------------------------------------- live signals
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot RIGHT NOW (0 when idle). A live
+        signal — the fleet router and the queue-depth autoscaler read it
+        mid-run instead of guessing load from finished-run telemetry."""
+        return len(self._sched.waiting) if self._sched is not None else 0
+
+    @property
+    def free_blocks(self) -> int:
+        """KV pool blocks currently unallocated — the admission headroom
+        signal (a request needs ``kv.blocks_for(context)`` of these)."""
+        return self.kv.allocator.num_free
 
     # ------------------------------------------------------------- helpers
     def _next_key(self):
@@ -208,13 +223,15 @@ class Engine:
                 )
         timer = StepTimer(warmup=0)
         sched = Scheduler(self.max_slots)
+        self._sched = sched
         t0 = time.perf_counter()
-        for r in reqs:
-            sched.submit(r, now=0.0)
+        seqs = [sched.submit(r, now=0.0) for r in reqs]
         params, state = self.model.params, self.model.state
         results = {}
         ttft = {}
         util_samples = []
+        queue_samples = []
+        free_blocks_min = self.kv.allocator.num_free
         decode_steps = 0
         prefill_dispatches = 0
         preemptions = 0
@@ -228,6 +245,7 @@ class Engine:
 
         def finish(seq):
             sched.finish(seq, self.kv)
+            seq.finished_at = elapsed()
             results[seq.request.request_id] = seq.output()
 
         while not (sched.idle and not prefill_jobs):
@@ -237,6 +255,8 @@ class Engine:
                 if seq is None:
                     break
                 timer.attribute("queue_wait", elapsed() - seq.enqueued_at)
+                if seq.admitted_at is None:
+                    seq.admitted_at = elapsed()
                 prefill_jobs.append([seq, self._prefill_chunks(seq), 0])
             if not sched.running:
                 # Nothing running and nothing admittable: the queue head's
@@ -281,6 +301,7 @@ class Engine:
                     seq.num_generated += 1
                     if seq.num_generated == 1:
                         ttft[seq.request.request_id] = elapsed()
+                        seq.first_token_at = elapsed()
                     if seq.finished or first == self.eos_id:
                         finish(seq)
                 else:
@@ -341,6 +362,8 @@ class Engine:
             timer.attribute("decode", time.perf_counter() - td)
             decode_steps += 1
             util_samples.append(self.kv.utilization())
+            queue_samples.append(len(sched.waiting))
+            free_blocks_min = min(free_blocks_min, self.kv.allocator.num_free)
             for seq in ready:
                 tok = int(sampled[seq.slot])
                 self.kv.positions[seq.slot] = seq.context_len
@@ -361,10 +384,33 @@ class Engine:
         report["tokens_per_sec"] = round(
             report["generated_tokens"] / report["total_seconds"], 3
         )
+        vals = list(ttft.values())
         report["time_to_first_token"] = {
-            "mean": round(float(np.mean(list(ttft.values()))), 4),
-            "max": round(float(np.max(list(ttft.values()))), 4),
+            "mean": round(float(np.mean(vals)), 4),
+            "p50": round(float(np.percentile(vals, 50)), 4),
+            "p99": round(float(np.percentile(vals, 99)), 4),
+            "max": round(float(np.max(vals)), 4),
         }
+        # Per-request lifecycle rows: the p50/p99 inputs, and the raw
+        # signal a router/autoscaler replays when tuning admission (mean
+        # TTFT alone hides the tail that SLOs are written against).
+        report["requests"] = [
+            {
+                "request_id": s.request.request_id,
+                "enqueued_s": round(float(s.submitted_at), 4),
+                "admitted_s": round(float(s.admitted_at), 4),
+                "first_token_s": round(float(s.first_token_at), 4),
+                "finished_s": round(float(s.finished_at), 4),
+                "preemptions": s.preemptions,
+            }
+            for s in seqs
+        ]
+        report["queue_depth"] = {
+            "mean": round(float(np.mean(queue_samples)), 4)
+            if queue_samples else 0.0,
+            "peak": int(np.max(queue_samples)) if queue_samples else 0,
+        }
+        report["free_blocks_min"] = int(free_blocks_min)
         report["decode_steps"] = decode_steps
         report["prefill_dispatches"] = prefill_dispatches
         report["preemptions"] = preemptions

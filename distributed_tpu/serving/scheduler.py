@@ -66,6 +66,14 @@ class Sequence:
         self.num_generated = 0
         self.submitted_at: Optional[float] = None
         self.enqueued_at: Optional[float] = None  # last (re-)queue time
+        # Lifecycle timestamps (first occurrence each; the driving loop's
+        # clock): admission into a decode slot, first generated token,
+        # completion. Telemetry consumers (the serving engine's per-request
+        # rows, the fleet router's SLO accounting) read these instead of
+        # re-deriving lifecycle from event ordering.
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
         self.preemptions = 0
 
     @property
@@ -115,6 +123,16 @@ class Scheduler:
         seq = Sequence(request)
         seq.submitted_at = now
         seq.enqueued_at = now
+        self.waiting.append(seq)
+        return seq
+
+    def enqueue(self, seq: Sequence, now: float) -> Sequence:
+        """Queue an EXISTING sequence — the fleet path, where sequences
+        outlive any one scheduler (a router hands them between replicas
+        and re-queues them when a replica dies mid-request)."""
+        seq.enqueued_at = now
+        if seq.submitted_at is None:
+            seq.submitted_at = now
         self.waiting.append(seq)
         return seq
 

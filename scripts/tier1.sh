@@ -102,6 +102,20 @@ if [ -n "${TIER1_DATA_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_FLEET_SMOKE=1: same idea for the serving fleet — runs the
+# router/autoscaler/handoff/fleet tests, the serving runtime they build
+# on, and the bench fleet smoke (~30 s) so fleet/router/replica changes
+# iterate fast. The replica-count x fault matrix stays @slow (run it
+# with -m slow when touching the kill/requeue paths). NOT a tier-1
+# substitute.
+if [ -n "${TIER1_FLEET_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py \
+        tests/test_serving.py \
+        "tests/test_bench.py::test_bench_fleet_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

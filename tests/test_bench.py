@@ -129,6 +129,33 @@ def test_bench_serve_smoke():
     assert out["workload"]["useful_tokens"] > 0
 
 
+def test_bench_fleet_smoke():
+    """The fleet mode at tiny shapes: the full path — bursty open-loop
+    arrivals, the replica-count sweep, the kill-a-replica recovery row —
+    and the artifact schema. The scaling GATE (tokens/s strictly
+    increasing with decode replicas) is asserted inside bench_fleet at
+    every shape; the real numbers come from `python bench.py fleet`
+    (BENCH_fleet.json)."""
+    out = bench.bench_fleet(
+        num_requests=8, replica_counts=(1, 2), max_slots=2, block_size=8,
+        vocab=32, num_layers=1, d_model=16, num_heads=2, max_len=64,
+        prompt_range=(2, 6), new_range=(8, 16), burst_size=4,
+        burst_gap_s=0.005, kill_replicas=2, kill_at_step=2,
+    )
+    assert out["unit"] == "tokens/s" and out["value"] > 0
+    assert [r["decode_replicas"] for r in out["scaling"]] == [1, 2]
+    r1, r2 = out["scaling"]
+    assert r2["tokens_per_sec"] > r1["tokens_per_sec"]
+    assert r2["speedup_vs_r1"] >= 1.0 == r1["speedup_vs_r1"]
+    assert out["ttft_p99_s"] >= out["ttft_p50_s"] > 0
+    kill = out["kill"]
+    assert kill["lost_requests"] == 0
+    assert kill["token_exact_vs_unfaulted"] is True
+    assert kill["respawned"] is True and kill["requeued_requests"] >= 0
+    assert "virtual" in out["clock"]
+    assert out["arrivals"]["useful_tokens"] > 0
+
+
 def test_bench_quant_smoke():
     """The quant mode at tiny shapes: exercises the full path — build,
     quantize-on-load, byte accounting, decode-fidelity probes, the FSDP

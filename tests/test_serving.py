@@ -110,6 +110,30 @@ def test_eos_stops_a_sequence_early(lm):
     np.testing.assert_array_equal(out, full[: t_p + stop + 1])
 
 
+def test_engine_per_request_lifecycle_rows(lm):
+    """The telemetry the fleet router/autoscaler consume: per-request
+    lifecycle timestamps, tail TTFT percentiles, and live queue/pool
+    signals — not just run-level means."""
+    prompts, news = _requests(seed=5, n=5)
+    engine = Engine(lm, max_slots=2, block_size=4, max_len=64)
+    assert engine.queue_depth == 0  # idle: live signals read clean
+    assert engine.free_blocks == engine.kv.allocator.num_allocatable
+    engine.run([Request(p, m) for p, m in zip(prompts, news)])
+    t = engine.last_run_telemetry
+    rows = t["requests"]
+    assert len(rows) == 5
+    for row in rows:
+        assert row["enqueued_s"] <= row["admitted_s"] <= \
+            row["first_token_s"] <= row["finished_s"]
+    ttft = t["time_to_first_token"]
+    assert ttft["p50"] <= ttft["p99"] <= ttft["max"]
+    assert ttft["mean"] > 0
+    # 5 requests over 2 slots: a queue existed at some decode step.
+    assert t["queue_depth"]["peak"] >= 1
+    assert 0 <= t["free_blocks_min"] <= engine.kv.allocator.num_allocatable
+    assert engine.free_blocks == engine.kv.allocator.num_allocatable
+
+
 # ------------------------------------------------------- block accounting --
 def test_block_allocator_accounting():
     alloc = BlockAllocator(8)  # block 0 reserved: 7 allocatable
