@@ -1829,6 +1829,150 @@ def bench_fleet(num_requests=64, replica_counts=(1, 2, 4), max_slots=4,
     }
 
 
+# --------------------------------------------------------------------- rl --
+def bench_rl(vocab=512, num_layers=4, d_model=256, num_heads=8,
+             max_len=128, max_slots=8, block_size=16, num_prompts=8,
+             prompt_len=8, num_samples=4, max_new_tokens=32, iterations=4,
+             learning_rate=1e-3, kl_coef=0.01, length_coef=0.0,
+             train_epochs=1, restart_probe_tokens=4, seed=0):
+    """Online post-training closed loop (``python bench.py rl``, artifact
+    BENCH_rl.json; docs/RL.md). One process group runs trainer AND
+    server: each iteration samples ``num_prompts x num_samples`` rollouts
+    on the serving engine (per-token logprobs captured in the fixed-shape
+    dispatches), scores them with the length-penalized-logprob reward,
+    takes one REINFORCE+KL policy-gradient step through the existing fit
+    path, and hot-swaps the new weights into the live engine with
+    ``Engine.update_weights``. Pinned facts:
+
+    1. **Learning** — mean reward strictly increases across iterations
+       (asserted): the loop is closed for real, rollouts -> update ->
+       better rollouts, on the ``lm_l4_d256`` serving-bench family.
+    2. **Loop couplings** — rollout tokens/s, train steps/s, and
+       weight-sync latency per iteration (iteration 1 pays every compile;
+       summary rows are medians over the warm iterations).
+    3. **Hot-swap vs restart** — the same weight delivery done the old
+       way: checkpoint the trained weights, restore them into the model,
+       build a fresh engine, decode a first token (what a restarted
+       serving process must do before serving; on this CPU box that
+       includes the re-jit a real fleet bounds with the persistent
+       compile cache). Asserted: the in-place swap is faster.
+
+    1-core caveat (the PERF.md precedent): rollout and train phases
+    share one CPU, so their rates here measure dispatch overhead, not
+    accelerator throughput, and the swap-vs-restart gap narrows on warm
+    compile caches — the artifact records the mechanisms (logprob
+    capture, version boundaries, no-restart swap), the chips record the
+    speed."""
+    import distributed_tpu.serving as serving
+    import distributed_tpu.rl as rl
+
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        vocab, num_layers=num_layers, d_model=d_model,
+        num_heads=num_heads, max_len=max_len,
+    ))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((32,))
+    engine = serving.Engine(
+        model, max_slots, block_size, max_len=max_len, temperature=1.0,
+        seed=seed,
+    )
+    pt = rl.PostTrainer(
+        model, engine,
+        reward_fn=rl.length_penalized_logprob(length_coef),
+        learning_rate=learning_rate, kl_coef=kl_coef, seed=seed,
+    )
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+        for _ in range(num_prompts)
+    ]
+    rows = pt.train(
+        prompts, iterations=iterations, num_samples=num_samples,
+        max_new_tokens=max_new_tokens, train_epochs=train_epochs,
+    )
+    rewards = [r["reward_mean"] for r in rows]
+    for prev, cur in zip(rewards, rewards[1:]):
+        assert cur > prev, (
+            f"reward must improve every iteration: {rewards}"
+        )
+    warm = rows[1:] if len(rows) > 1 else rows
+    swap_s = float(np.median([r["weight_sync_s"] for r in warm]))
+
+    # Restart comparison: deliver the SAME trained weights by
+    # checkpoint-save -> restore -> fresh engine -> first served token.
+    import os
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        t0 = time.perf_counter()
+        path = os.path.join(tmp, "weights.npz")
+        model.save_weights(path)
+        model.load_weights(path)
+        restarted = serving.Engine(
+            model, max_slots, block_size, max_len=max_len,
+            temperature=1.0, seed=seed,
+        )
+        restarted.run([serving.Request(prompts[0],
+                                       int(restart_probe_tokens))])
+        restart_s = time.perf_counter() - t0
+    assert swap_s < restart_s, (
+        f"hot-swap ({swap_s:.4f}s) must beat save+restore restart "
+        f"({restart_s:.4f}s)"
+    )
+
+    return {
+        "metric": (
+            f"rl_loop_rollout_tokens_per_sec_lm_l{num_layers}_d{d_model}"
+        ),
+        "value": round(
+            float(np.median([r["rollout_tokens_per_sec"] for r in warm])), 2
+        ),
+        "unit": "tokens/s",
+        "train_steps_per_sec": round(
+            float(np.median([r["train_steps_per_sec"] for r in warm])), 3
+        ),
+        "weight_sync_latency_s": round(swap_s, 4),
+        "hot_swap_vs_restart": {
+            "hot_swap_s": round(swap_s, 4),
+            "save_restore_restart_s": round(restart_s, 4),
+            "speedup": round(restart_s / swap_s, 1),
+            "restart_includes": "save_weights + load_weights + fresh "
+                                "Engine (pool alloc + re-jit) + first "
+                                f"{restart_probe_tokens} tokens",
+        },
+        "reward_by_iteration": [round(r, 4) for r in rewards],
+        "reward_monotonic": True,
+        "kl_by_iteration": [
+            None if r["kl"] is None else round(r["kl"], 4) for r in rows
+        ],
+        "weights_version_final": rows[-1]["weights_version"],
+        "iterations": [
+            {k: r[k] for k in (
+                "iteration", "reward_mean", "loss", "kl", "kl_coef",
+                "rollout_tokens_per_sec", "train_steps_per_sec",
+                "weight_sync_s", "weights_version",
+            )}
+            for r in rows
+        ],
+        "clock": "iteration 1 includes all XLA compiles (engine "
+                 "dispatches, train step, KL probe); summary medians use "
+                 "warm iterations only; 1-core box — see docs/RL.md",
+        "workload": {
+            "num_prompts": num_prompts,
+            "num_samples": num_samples,
+            "prompt_len": prompt_len,
+            "max_new_tokens": max_new_tokens,
+            "iterations": iterations,
+            "max_slots": max_slots,
+            "block_size": block_size,
+            "learning_rate": learning_rate,
+            "kl_coef": kl_coef,
+            "reward": f"length_penalized_logprob({length_coef})",
+            "model": f"lm_l{num_layers}_d{d_model}_v{vocab}",
+        },
+    }
+
+
 # ------------------------------------------------------------------ quant --
 def bench_quant(vocab=512, num_layers=4, d_model=256, num_heads=8,
                 max_len=128, probe_batch=8, probe_len=32, seed=0):
@@ -2223,7 +2367,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
     known = {"mnist", "multistep", "overlap", "input", "convergence",
              "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
              "precision", "compile_cache", "serve", "elastic", "quant",
-             "fused_update", "autoshard", "fleet"}
+             "fused_update", "autoshard", "fleet", "rl"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -2275,6 +2419,11 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # kill-a-replica recovery row (BENCH_fleet.json;
         # docs/SERVING.md "Fleet").
         extra.append(bench_fleet())
+    if "rl" in modes:
+        # Opt-in: online post-training closed loop — rollout tokens/s,
+        # train steps/s, weight-sync latency, reward improvement, and the
+        # hot-swap-vs-restart row (BENCH_rl.json; docs/RL.md).
+        extra.append(bench_rl())
     if "elastic" in modes:
         # Opt-in: elastic gang 4->2->4 resize-to-first-step latency
         # (BENCH_elastic.json; docs/RESILIENCE.md "Elastic gangs").

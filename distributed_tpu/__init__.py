@@ -60,9 +60,10 @@ def __getattr__(name):
     # base import cost on CPU boxes. quant itself is light (jnp only) and
     # usually already bound by nn's layer imports; the Pallas machinery
     # stays behind ops.__getattr__ until an API that needs it is called.
-    if name in ("quant", "fleet"):
-        # fleet (the multi-replica serving tier) is lazy for the same
-        # reason: training-only processes never pay for it.
+    if name in ("quant", "fleet", "rl"):
+        # fleet (the multi-replica serving tier) and rl (online
+        # post-training) are lazy for the same reason: processes that
+        # only train or only serve never pay for them.
         import importlib
 
         return importlib.import_module(f".{name}", __name__)
@@ -106,5 +107,6 @@ __all__ = [
     "serving",
     "fleet",  # lazy: see __getattr__
     "quant",  # lazy: see __getattr__
+    "rl",  # lazy: see __getattr__
     "__version__",
 ]

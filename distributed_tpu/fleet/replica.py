@@ -35,7 +35,9 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ..serving.engine import _decode_dispatch, _prefill_dispatch
+from ..serving.engine import (
+    _decode_dispatch, _mix_seed, _prefill_dispatch, _token_key,
+)
 from ..serving.kv_cache import PagedKVCache
 from ..serving.scheduler import Scheduler, Sequence
 from .handoff import HandoffIncompatible, KVHandoff, install_kv, pack_kv
@@ -59,8 +61,7 @@ class EnginePrograms:
         self.model = model
         self.temperature = float(temperature)
         self.top_k = top_k
-        self._base_key = jax.random.PRNGKey(seed)
-        self._dispatches = 0
+        self.seed = int(seed)
         self.prefill_fn = model._scoped(jax.jit(
             functools.partial(
                 _prefill_dispatch, model.module, self.temperature,
@@ -76,9 +77,20 @@ class EnginePrograms:
             donate_argnums=(2,),
         ))
 
-    def next_key(self):
-        self._dispatches += 1
-        return jax.random.fold_in(self._base_key, self._dispatches)
+    def token_key(self, seq: Sequence) -> np.ndarray:
+        """Per-request, per-token sampling key (the engine's derivation):
+        depends only on (fleet seed, request seed, generated-token index),
+        so a sampled request decodes the same tokens whichever replica —
+        or post-kill re-queue — runs it."""
+        r = seq.request
+        return _token_key(
+            _mix_seed(
+                self.seed,
+                r.seed if getattr(r, "seed", None) is not None
+                else r.request_id,
+            ),
+            seq.num_generated,
+        )
 
 
 def _bucket(c: int, start: int, max_len: int) -> int:
@@ -122,10 +134,10 @@ class _ReplicaBase:
         buf = np.zeros((1, cb), np.int32)
         buf[0, :c] = seq.tokens[start:start + c]
         t0 = time.perf_counter()
-        tok, self.kv.caches = self.programs.prefill_fn(
+        tok, _logp, self.kv.caches = self.programs.prefill_fn(
             model.params, model.state, self.kv.caches, buf,
             self.kv.block_tables[seq.slot], np.int32(start),
-            np.int32(last_idx), self.programs.next_key(),
+            np.int32(last_idx), self.programs.token_key(seq),
         )
         tok = int(jax.device_get(tok))
         return tok, time.perf_counter() - t0
@@ -370,9 +382,11 @@ class DecodeReplica(_ReplicaBase):
             model = self.programs.model
             tokens = np.zeros((self.max_slots,), np.int32)
             mask = np.zeros((self.max_slots,), bool)
+            keys = np.zeros((self.max_slots, 2), np.uint32)
             for seq in ready:
                 tokens[seq.slot] = seq.last_token
                 mask[seq.slot] = True
+                keys[seq.slot] = self.programs.token_key(seq)
             tables = np.where(
                 mask[:, None], self.kv.block_tables, np.int32(0)
             )
@@ -380,9 +394,9 @@ class DecodeReplica(_ReplicaBase):
                 np.int32
             )
             t0 = time.perf_counter()
-            sampled, self.kv.caches = self.programs.decode_fn(
+            sampled, _logps, self.kv.caches = self.programs.decode_fn(
                 model.params, model.state, self.kv.caches, tokens,
-                tables, positions, self.programs.next_key(),
+                tables, positions, keys,
             )
             sampled = np.asarray(jax.device_get(sampled))
             spent += time.perf_counter() - t0

@@ -238,6 +238,82 @@ def loss_scale_value(opt_state):
     return None
 
 
+class EmaBaseline:
+    """Exponential-moving-average reward baseline for policy-gradient
+    advantages (``rl.PostTrainer``): ``advantage = reward - baseline``.
+    Host-side scalar state, like the learning-rate hyperparams — small
+    enough to live outside the jitted step, and it must NOT shard (every
+    rollout subtracts the same baseline or the gradient gains a spurious
+    per-shard offset). ``state_dict``/``load_state`` round-trip it through
+    checkpoint metadata."""
+
+    def __init__(self, decay: float = 0.9):
+        if not 0.0 <= decay < 1.0:
+            raise ValueError(f"decay must be in [0, 1); got {decay}")
+        self.decay = float(decay)
+        self.value = None  # None until the first update (cold start)
+
+    def update(self, reward_mean: float) -> float:
+        """Fold one iteration's mean reward in; returns the new baseline.
+        The first update adopts the observed mean outright (a 0-init
+        baseline would hand the whole first batch a large spurious
+        advantage)."""
+        r = float(reward_mean)
+        if self.value is None:
+            self.value = r
+        else:
+            self.value = self.decay * self.value + (1.0 - self.decay) * r
+        return self.value
+
+    def state_dict(self):
+        return {"decay": self.decay, "value": self.value}
+
+    def load_state(self, state):
+        self.decay = float(state["decay"])
+        self.value = None if state["value"] is None else float(state["value"])
+
+
+class AdaptiveKLCoef:
+    """PPO-style adaptive KL-penalty coefficient (Schulman et al., 2017):
+    after each policy update, grow the coefficient when the observed
+    policy-vs-reference KL overshoots ``target`` and shrink it when the
+    policy is moving too timidly. ``rl.PostTrainer`` accepts an instance
+    anywhere a fixed ``kl_coef`` float goes and calls ``update`` with the
+    measured post-update KL each iteration."""
+
+    def __init__(self, init_coef: float = 0.1, target: float = 0.01,
+                 factor: float = 1.5, tolerance: float = 1.5):
+        if init_coef < 0 or target <= 0 or factor <= 1 or tolerance < 1:
+            raise ValueError(
+                "need init_coef >= 0, target > 0, factor > 1, "
+                f"tolerance >= 1; got {init_coef}, {target}, {factor}, "
+                f"{tolerance}"
+            )
+        self.coef = float(init_coef)
+        self.target = float(target)
+        self.factor = float(factor)
+        self.tolerance = float(tolerance)
+
+    def update(self, observed_kl: float) -> float:
+        """Adapt to one iteration's measured KL; returns the new coef."""
+        kl = float(observed_kl)
+        if kl > self.target * self.tolerance:
+            self.coef *= self.factor
+        elif kl < self.target / self.tolerance:
+            self.coef /= self.factor
+        return self.coef
+
+    def state_dict(self):
+        return {"coef": self.coef, "target": self.target,
+                "factor": self.factor, "tolerance": self.tolerance}
+
+    def load_state(self, state):
+        self.coef = float(state["coef"])
+        self.target = float(state["target"])
+        self.factor = float(state["factor"])
+        self.tolerance = float(state["tolerance"])
+
+
 def sgd_with_cosine(learning_rate: float, steps: int, warmup: int = 0, momentum: float = 0.9):
     return optax.sgd(cosine_schedule(learning_rate, steps, warmup),
                      momentum=momentum)

@@ -13,8 +13,12 @@ decode loop.
     engine.last_run_telemetry  # tokens/s, TTFT, kv_utilization, stalls
 
 Greedy decode (``temperature=0``) is token-identical per request to
-``model.generate()``; ``bench.py serve`` measures the throughput/latency
-win over the static-batch baseline (docs/SERVING.md).
+``model.generate()``; sampled decode is bit-reproducible per request
+(``Request.seed``) and can capture per-token logprobs
+(``run(return_logprobs=True)``); ``Engine.update_weights`` hot-swaps
+served weights without a restart (the ``rl.PostTrainer`` sync seam —
+docs/RL.md). ``bench.py serve`` measures the throughput/latency win
+over the static-batch baseline (docs/SERVING.md).
 """
 
 from .engine import Engine

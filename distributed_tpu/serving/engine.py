@@ -33,6 +33,16 @@ Telemetry rides the existing ``StepTimer.attribute`` stall keys:
 ``queue_wait`` (request admission waits), ``prefill`` / ``decode``
 (dispatch walls), plus ``kv_utilization`` (mean/peak block-pool
 occupancy) in ``engine.last_run_telemetry``.
+
+Sampled decode is deterministic PER REQUEST: token keys derive from
+(engine seed, request seed, token index) alone, so rollouts with pinned
+seeds are bit-identical across runs, ``max_slots``, and preemption
+histories; ``run(return_logprobs=True)`` additionally captures each
+token's sampling logprob (computed in the fixed dispatch either way —
+the toggle never recompiles). ``update_weights(params)`` hot-swaps the
+served weights between decode steps under a documented staleness
+contract (docs/RL.md): in-flight sequences keep their KV, and the
+``weights_version`` boundary is recorded per token row.
 """
 
 from __future__ import annotations
@@ -51,37 +61,100 @@ from .kv_cache import PagedKVCache
 from .scheduler import Request, Scheduler
 
 
+_M64 = (1 << 64) - 1
+
+
+def _mix_seed(engine_seed: int, request_seed: int) -> int:
+    """One 64-bit mix of (engine seed, request seed) — the per-request
+    sampling-stream identity. Pure host arithmetic so deriving a key never
+    costs a device dispatch."""
+    return (
+        (int(engine_seed) + 1) * 0xD1342543DE82EF95
+        + (int(request_seed) + 1) * 0x9E3779B97F4A7C15
+    ) & _M64
+
+
+def _token_key(sample_seed: int, index: int) -> np.ndarray:
+    """Deterministic uint32[2] sampling key for generated-token ``index``
+    of the request identified by ``sample_seed`` (splitmix64 finalizer
+    over the pair). The key depends on NOTHING else — not the slot, not
+    the decode step the scheduler ran, not ``max_slots`` — which is what
+    makes sampled rollouts bit-reproducible across runs and engine
+    shapes."""
+    x = (
+        int(sample_seed) + (int(index) + 1) * 0xBF58476D1CE4E5B9
+    ) & _M64
+    x ^= x >> 30
+    x = (x * 0xBF58476D1CE4E5B9) & _M64
+    x ^= x >> 27
+    x = (x * 0x94D049BB133111EB) & _M64
+    x ^= x >> 31
+    return np.array([x >> 32, x & 0xFFFFFFFF], np.uint32)
+
+
+def _sample_with_logprob(logits, keys, temperature, top_k):
+    """Sample every slot's next token AND its sampling logprob in one
+    pass: ``logits`` (S, V), ``keys`` (S, 2) per-slot uint32 key data.
+    The logprob is under the distribution actually sampled from —
+    top_k-truncated, temperature-scaled softmax (raw softmax when greedy:
+    temperature <= 0 takes the argmax, and its reported logprob is the
+    token's unscaled log-likelihood, the reference-scoring convention).
+    Computed unconditionally: one (S, V) log_softmax rides free next to
+    the matmuls that produced the logits, so toggling host-side capture
+    (``run(return_logprobs=...)``) never changes the compiled program."""
+    logits = logits.astype(jnp.float32)
+    if top_k is not None:
+        k = min(int(top_k), logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    t = float(temperature) if temperature > 0.0 else 1.0
+    scaled = logits / jnp.float32(t)
+    logp_all = jax.nn.log_softmax(scaled, axis=-1)
+    if temperature <= 0.0:
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        toks = jax.vmap(jax.random.categorical)(keys, scaled).astype(
+            jnp.int32
+        )
+    logp = jnp.take_along_axis(logp_all, toks[:, None], axis=-1)[:, 0]
+    return toks, logp
+
+
 def _prefill_dispatch(module, temperature, top_k, policy, dtype_hints,
                       params, state, caches, tokens, block_table, start,
                       last_idx, key):
     """One prompt-chunk prefill for one sequence: tokens (1, Cb) covering
     absolute positions [start, start+Cb) (right-padded past the real
     chunk), KV scattered into the sequence's blocks, and the next token
-    sampled from the last REAL position's logits (meaningful only on the
-    final chunk; earlier chunks' samples are discarded host-side)."""
+    (plus its sampling logprob) sampled from the last REAL position's
+    logits (meaningful only on the final chunk; earlier chunks' samples
+    are discarded host-side)."""
     params = _cast_for_compute(policy, params, dtype_hints)
     out, caches = module.paged_prefill(
         params, state, caches, tokens, block_table=block_table, start=start
     )
     last = jax.lax.dynamic_slice_in_dim(out[0], last_idx, 1, axis=0)
-    tok = Model._sample_logits(last, key, temperature, top_k)  # (1,)
-    return tok[0], caches
+    tok, logp = _sample_with_logprob(last, key[None], temperature, top_k)
+    return tok[0], logp[0], caches
 
 
 def _decode_dispatch(module, temperature, top_k, policy, dtype_hints,
                      params, state, caches, tokens, block_tables, positions,
-                     key):
+                     keys):
     """One continuous-batching decode step over every slot: tokens (S,),
-    per-slot block tables and positions. Slots not currently decoding
-    carry all-trash tables, so their scatter writes are harmless and
-    their sampled tokens are ignored by the scheduler."""
+    per-slot block tables, positions, and sampling keys. Slots not
+    currently decoding carry all-trash tables, so their scatter writes
+    are harmless and their sampled tokens are ignored by the
+    scheduler."""
     params = _cast_for_compute(policy, params, dtype_hints)
     logits, caches = module.paged_decode(
         params, state, caches, tokens[:, None],
         block_tables=block_tables, positions=positions,
     )
-    sampled = Model._sample_logits(logits[:, 0], key, temperature, top_k)
-    return sampled, caches
+    sampled, logp = _sample_with_logprob(
+        logits[:, 0], keys, temperature, top_k
+    )
+    return sampled, logp, caches
 
 
 class Engine:
@@ -126,8 +199,15 @@ class Engine:
         self.temperature = float(temperature)
         self.top_k = top_k
         self.eos_id = eos_id
-        self._base_key = jax.random.PRNGKey(seed)
-        self._dispatches = 0
+        self.seed = int(seed)
+        # Served weights are an engine-owned SNAPSHOT of the model's
+        # params/state, taken here and replaced only through
+        # update_weights() — so a trainer sharing the model object in the
+        # same process (rl.PostTrainer) can step the masters freely while
+        # the engine keeps serving the last synced version.
+        self._params = model.params
+        self._state = model.state
+        self._weights_version = 0
         # Positional capacity check up front (abstract: no allocation) —
         # the paged path cannot raise at trace time the way init_cache
         # does, so a too-short learned positional table must fail HERE,
@@ -149,21 +229,25 @@ class Engine:
         # Both dispatches jit once (decode shapes are fixed; prefill
         # retraces only per distinct bucketed chunk length) under the
         # model's strategy/precision scopes — same discipline as every
-        # Model step function.
-        self._prefill_fn = self.model._scoped(jax.jit(
+        # Model step function. The raw jitted objects are kept
+        # (self._*_jit) so tests can pin the no-recompile contract via
+        # _cache_size() across weight swaps and logprob-capture toggles.
+        self._prefill_jit = jax.jit(
             functools.partial(
                 _prefill_dispatch, model.module, self.temperature,
                 self.top_k, model.precision, model._dtype_hints,
             ),
             donate_argnums=(2,),
-        ))
-        self._decode_fn = self.model._scoped(jax.jit(
+        )
+        self._decode_jit = jax.jit(
             functools.partial(
                 _decode_dispatch, model.module, self.temperature,
                 self.top_k, model.precision, model._dtype_hints,
             ),
             donate_argnums=(2,),
-        ))
+        )
+        self._prefill_fn = self.model._scoped(self._prefill_jit)
+        self._decode_fn = self.model._scoped(self._decode_jit)
         self.last_run_telemetry = None
         self._sched: Optional[Scheduler] = None  # live during run()
 
@@ -181,10 +265,78 @@ class Engine:
         signal (a request needs ``kv.blocks_for(context)`` of these)."""
         return self.kv.allocator.num_free
 
+    # --------------------------------------------------------- weight swap
+    @property
+    def weights_version(self) -> int:
+        """Monotonic counter of served-weight generations: 0 for the
+        construction-time snapshot, +1 per ``update_weights``. Threaded
+        through ``last_run_telemetry`` and per-token request rows so every
+        generated token names the weights that produced it."""
+        return self._weights_version
+
+    def update_weights(self, params) -> int:
+        """Hot-swap the served weights WITHOUT a restart: validate the new
+        tree against the live one, re-place it under the engine model's
+        strategy (the ``quant.quantize_model`` quantize-on-load
+        re-placement idiom, generalized to any same-shape tree), and bump
+        ``weights_version``. Returns the new version.
+
+        Staleness contract (docs/RL.md, docs/SERVING.md "Weight
+        hot-swap"): the swap is atomic at DISPATCH granularity. In-flight
+        sequences keep their KV cache — same shapes, new weights — so a
+        sequence straddling a swap decodes its remaining tokens with new
+        weights attending over KV written by old ones; its per-token
+        ``weights_versions`` rows record exactly where the boundary fell.
+        No KV is recomputed and no request is evicted: the trade
+        production RL rollout loops make deliberately (the alternative —
+        flushing the pool — costs a full re-prefill of every running
+        sequence for a one-update-old prefix).
+
+        Tree structure, leaf shapes AND dtypes must match the live params
+        exactly (a shape/dtype change would silently retrace the fixed
+        decode program; a different architecture needs a new Engine) —
+        mismatches raise ``ValueError`` loudly. State (e.g. BatchNorm
+        stats) is not swapped; serving LMs carry none, and a model that
+        does should rebuild its engine.
+        """
+        ref_paths = jax.tree_util.tree_leaves_with_path(self._params)
+        ref_struct = jax.tree_util.tree_structure(self._params)
+        got_struct = jax.tree_util.tree_structure(params)
+        if ref_struct != got_struct:
+            raise ValueError(
+                "update_weights: new param tree structure does not match "
+                f"the served tree: {got_struct} vs {ref_struct}"
+            )
+        for (kpath, have), want in zip(
+            ref_paths, jax.tree_util.tree_leaves(params)
+        ):
+            if tuple(have.shape) != tuple(getattr(want, "shape", ())):
+                raise ValueError(
+                    "update_weights: shape mismatch at "
+                    f"{jax.tree_util.keystr(kpath)}: new weights have "
+                    f"{tuple(getattr(want, 'shape', ()))}, engine serves "
+                    f"{tuple(have.shape)}"
+                )
+            if jnp.dtype(jnp.result_type(want)) != jnp.dtype(have.dtype):
+                raise ValueError(
+                    "update_weights: dtype mismatch at "
+                    f"{jax.tree_util.keystr(kpath)}: new weights are "
+                    f"{jnp.result_type(want)}, engine serves {have.dtype} "
+                    "(a dtype change would retrace the fixed decode "
+                    "dispatch)"
+                )
+        placed = self.model.strategy.put_params(
+            params, hints=self.model.module.sharding_hints()
+        )
+        # Block until resident: the next dispatch must read the new
+        # weights, and the latency reported by callers (the bench's
+        # weight-sync row) must cover the transfer, not enqueue it.
+        jax.block_until_ready(placed)
+        self._params = placed
+        self._weights_version += 1
+        return self._weights_version
+
     # ------------------------------------------------------------- helpers
-    def _next_key(self):
-        self._dispatches += 1
-        return jax.random.fold_in(self._base_key, self._dispatches)
 
     def _bucket(self, c: int, start: int) -> int:
         """Chunk lengths round up to a multiple of 64 (one compile per
@@ -203,12 +355,25 @@ class Engine:
         ]
 
     # ---------------------------------------------------------------- run
-    def run(self, requests: SequenceT) -> List[np.ndarray]:
+    def run(self, requests: SequenceT, *, return_logprobs: bool = False,
+            on_decode_step=None) -> List[np.ndarray]:
         """Serve ``requests`` (a sequence of ``serving.Request``, or
         (prompt, max_new_tokens) pairs) to completion; returns each
         request's prompt+generated tokens in submission order —
         row-compatible with ``generate()`` per request. Telemetry for the
-        run lands in ``engine.last_run_telemetry``."""
+        run lands in ``engine.last_run_telemetry``.
+
+        ``return_logprobs=True`` records each generated token's sampling
+        logprob into the per-request telemetry rows (``"logprobs"``) —
+        the rollout capture RL training consumes. The logprobs are
+        computed inside the fixed-shape dispatches either way (one
+        log_softmax next to the logits), so toggling this NEVER
+        recompiles; the flag only switches the host-side bookkeeping.
+
+        ``on_decode_step``: optional ``fn(engine, decode_step)`` hook
+        called after every decode dispatch — the seam a driver uses to
+        interleave control actions (e.g. ``update_weights`` mid-run, the
+        hot-swap staleness-contract tests) with a live batch."""
         reqs = [
             r if isinstance(r, Request) else Request(r[0], r[1])
             for r in requests
@@ -226,7 +391,12 @@ class Engine:
         self._sched = sched
         t0 = time.perf_counter()
         seqs = [sched.submit(r, now=0.0) for r in reqs]
-        params, state = self.model.params, self.model.state
+        for seq in seqs:
+            r = seq.request
+            seq.sample_seed = _mix_seed(
+                self.seed, r.seed if r.seed is not None else r.request_id
+            )
+        version_at_start = self._weights_version
         results = {}
         ttft = {}
         util_samples = []
@@ -281,23 +451,27 @@ class Engine:
                 buf = np.zeros((1, cb), np.int32)
                 buf[0, :c] = seq.tokens[start:start + c]
                 tp = time.perf_counter()
-                tok, self.kv.caches = self._prefill_fn(
-                    params, state, self.kv.caches, buf,
+                tok, logp, self.kv.caches = self._prefill_fn(
+                    self._params, self._state, self.kv.caches, buf,
                     self.kv.block_tables[seq.slot],
                     np.int32(start),
                     np.int32(seq.context_len - 1 - start
                              if idx == len(chunks) - 1 else c - 1),
-                    self._next_key(),
+                    _token_key(seq.sample_seed, seq.num_generated),
                 )
                 prefill_dispatches += 1
                 job[2] = idx + 1
                 if job[2] == len(chunks):
                     # Final chunk: the sampled continuation is real.
-                    first = int(jax.device_get(tok))
+                    first, first_lp = jax.device_get((tok, logp))
+                    first = int(first)
                     timer.attribute("prefill", time.perf_counter() - tp)
                     prefill_jobs.pop(0)
                     self.kv.positions[seq.slot] = seq.context_len
                     seq.tokens.append(first)
+                    seq.token_versions.append(self._weights_version)
+                    if return_logprobs:
+                        seq.logprobs.append(float(first_lp))
                     seq.num_generated += 1
                     if seq.num_generated == 1:
                         ttft[seq.request.request_id] = elapsed()
@@ -341,9 +515,13 @@ class Engine:
                 continue
             tokens = np.zeros((self.max_slots,), np.int32)
             ready_mask = np.zeros((self.max_slots,), bool)
+            keys = np.zeros((self.max_slots, 2), np.uint32)
             for seq in ready:
                 tokens[seq.slot] = seq.last_token
                 ready_mask[seq.slot] = True
+                keys[seq.slot] = _token_key(
+                    seq.sample_seed, seq.num_generated
+                )
             # Slots that are free or mid-prefill get all-trash tables for
             # this dispatch: their scatter writes must not touch blocks a
             # live (possibly half-prefilled) sequence owns.
@@ -354,11 +532,12 @@ class Engine:
                 np.int32
             )
             td = time.perf_counter()
-            sampled, self.kv.caches = self._decode_fn(
-                params, state, self.kv.caches, tokens, tables, positions,
-                self._next_key(),
+            sampled, logps, self.kv.caches = self._decode_fn(
+                self._params, self._state, self.kv.caches, tokens, tables,
+                positions, keys,
             )
-            sampled = np.asarray(jax.device_get(sampled))
+            sampled, logps = jax.device_get((sampled, logps))
+            sampled = np.asarray(sampled)
             timer.attribute("decode", time.perf_counter() - td)
             decode_steps += 1
             util_samples.append(self.kv.utilization())
@@ -368,9 +547,14 @@ class Engine:
                 tok = int(sampled[seq.slot])
                 self.kv.positions[seq.slot] = seq.context_len
                 seq.tokens.append(tok)
+                seq.token_versions.append(self._weights_version)
+                if return_logprobs:
+                    seq.logprobs.append(float(logps[seq.slot]))
                 seq.num_generated += 1
                 if seq.finished or tok == self.eos_id:
                     finish(seq)
+            if on_decode_step is not None:
+                on_decode_step(self, decode_steps)
         report = timer.stall_report()
         report["kv_utilization"] = {
             "mean": round(float(np.mean(util_samples)), 4)
@@ -394,6 +578,21 @@ class Engine:
         # Per-request lifecycle rows: the p50/p99 inputs, and the raw
         # signal a router/autoscaler replays when tuning admission (mean
         # TTFT alone hides the tail that SLOs are written against).
+        # weights_versions compacts the per-token version list into
+        # [{"version", "tokens"}] spans: one span per run for a request
+        # that never straddled an update_weights, and the exact boundary
+        # token when one did (the hot-swap staleness record). "logprobs"
+        # (full precision — RL forms importance ratios from these) rides
+        # along when the run captured them.
+        def _version_spans(versions):
+            spans = []
+            for v in versions:
+                if spans and spans[-1]["version"] == v:
+                    spans[-1]["tokens"] += 1
+                else:
+                    spans.append({"version": int(v), "tokens": 1})
+            return spans
+
         report["requests"] = [
             {
                 "request_id": s.request.request_id,
@@ -402,9 +601,21 @@ class Engine:
                 "first_token_s": round(float(s.first_token_at), 4),
                 "finished_s": round(float(s.finished_at), 4),
                 "preemptions": s.preemptions,
+                "weights_versions": _version_spans(
+                    s.token_versions[: s.request.max_new_tokens]
+                ),
+                **(
+                    {"logprobs": [
+                        float(lp) for lp in
+                        s.logprobs[: s.request.max_new_tokens]
+                    ]}
+                    if return_logprobs else {}
+                ),
             }
             for s in seqs
         ]
+        report["weights_version"] = self._weights_version
+        report["weight_swaps"] = self._weights_version - version_at_start
         report["queue_depth"] = {
             "mean": round(float(np.mean(queue_samples)), 4)
             if queue_samples else 0.0,

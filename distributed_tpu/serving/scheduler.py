@@ -39,10 +39,20 @@ _ids = itertools.count()
 class Request:
     """One generation request: ``prompt`` (1-D int tokens, >= 1) and the
     number of tokens to generate. ``request_id`` is assigned on
-    construction when not given."""
+    construction when not given.
+
+    ``seed``: per-request sampling seed. A request's sampled (non-greedy)
+    token stream is a pure function of (engine seed, this seed, generated-
+    token index) — NOT of the slot it lands in, the decode step it runs
+    at, ``max_slots``, or preemptions around it — so rollouts with pinned
+    seeds are bit-reproducible across runs and engine shapes (the serving
+    analogue of the greedy token-exact discipline). ``None`` falls back to
+    ``request_id`` (deterministic within a process, where ids start at 0,
+    but shared-counter order-dependent across engines)."""
 
     prompt: np.ndarray
     max_new_tokens: int
+    seed: Optional[int] = None
     request_id: int = field(default_factory=lambda: next(_ids))
 
     def __post_init__(self):
@@ -75,6 +85,15 @@ class Sequence:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.preemptions = 0
+        # Per-generated-token capture, index-aligned with the generated
+        # suffix of ``tokens`` (preemption keeps generated tokens, so
+        # these survive requeues too): sampling logprobs (filled when the
+        # engine runs with return_logprobs=True) and the engine
+        # weights_version that produced each token (always filled — the
+        # hot-swap staleness contract is read off the version boundary).
+        self.logprobs: List[float] = []
+        self.token_versions: List[int] = []
+        self.sample_seed: int = 0  # mixed (engine, request) seed; set by run()
 
     @property
     def prompt_len(self) -> int:

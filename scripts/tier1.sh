@@ -116,6 +116,19 @@ if [ -n "${TIER1_FLEET_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_RL_SMOKE=1: same idea for online post-training — runs the rl
+# loop tests, the serving runtime they ride on (logprob capture, RNG
+# determinism, the update_weights hot-swap), and the bench rl smoke
+# (~60 s) so PostTrainer/engine-swap changes iterate fast. NOT a tier-1
+# substitute.
+if [ -n "${TIER1_RL_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_rl.py \
+        tests/test_serving.py \
+        "tests/test_bench.py::test_bench_rl_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

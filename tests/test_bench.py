@@ -156,6 +156,32 @@ def test_bench_fleet_smoke():
     assert out["arrivals"]["useful_tokens"] > 0
 
 
+def test_bench_rl_smoke():
+    """The rl mode at tiny shapes: the full closed loop — sampled
+    rollouts with logprob capture, reward scoring, the REINFORCE+KL fit
+    step, the weight hot-swap — plus both GATES (reward strictly
+    improving every iteration; hot-swap faster than the
+    save+restore+fresh-engine restart), asserted inside bench_rl at
+    every shape. The real numbers come from `python bench.py rl`
+    (BENCH_rl.json)."""
+    out = bench.bench_rl(
+        vocab=32, num_layers=1, d_model=16, num_heads=2, max_len=64,
+        max_slots=2, block_size=8, num_prompts=4, prompt_len=4,
+        num_samples=4, max_new_tokens=16, iterations=3,
+        learning_rate=1e-2, train_epochs=2,
+    )
+    assert out["unit"] == "tokens/s" and out["value"] > 0
+    assert out["train_steps_per_sec"] > 0
+    assert out["weight_sync_latency_s"] >= 0
+    assert out["reward_monotonic"] is True
+    assert len(out["reward_by_iteration"]) == 3
+    assert out["weights_version_final"] == 3
+    hs = out["hot_swap_vs_restart"]
+    assert hs["hot_swap_s"] < hs["save_restore_restart_s"]
+    assert len(out["iterations"]) == 3
+    assert out["workload"]["model"] == "lm_l1_d16_v32"
+
+
 def test_bench_quant_smoke():
     """The quant mode at tiny shapes: exercises the full path — build,
     quantize-on-load, byte accounting, decode-fidelity probes, the FSDP
@@ -181,6 +207,10 @@ def test_bench_quant_smoke():
             == pytest.approx(2.0)
 
 
+# @slow (tier-1 budget, PR 12): 11s, and the planner is pinned by
+# test_autoshard.py's in-tier suite (incl. e2e compile("auto")); the
+# bench-path schema runs via `python bench.py autoshard` and -m slow.
+@pytest.mark.slow
 def test_bench_autoshard_smoke():
     """The autoshard mode at tiny shapes: the full path — two
     compile(strategy="auto") builds, the measured dp/zero1/fsdp

@@ -85,6 +85,10 @@ def test_k_larger_than_epoch():
     assert np.isfinite(h.history["loss"]).all()
 
 
+# @slow (tier-1 budget, PR 12): 9s composition matrix — each mechanism
+# keeps its own in-tier pin (K8==K1 above, chunked head in
+# test_chunked_head, grad_accum in test_zero, clip in test_fit).
+@pytest.mark.slow
 def test_composes_with_head_chunks_accumulation_and_clip():
     """steps_per_execution x head_chunks x gradient_accumulation_steps x
     grad_clip: the scanned body is the SAME chunked step the K=1 path
@@ -192,6 +196,11 @@ def test_int_save_freq_crosses_boundaries(tmp_path):
     assert all(s % 4 == 0 for s in saved)
 
 
+# @slow (tier-1 budget, PR 12): 9s tail x save_freq edge matrix;
+# boundary-crossing saves and K-aligned resume each keep their own
+# in-tier tests (test_int_save_freq_crosses_boundaries,
+# test_checkpoint_resume_k_aligned).
+@pytest.mark.slow
 def test_tail_dispatch_with_save_freq_inside_it(tmp_path):
     """next_k tail behavior x checkpointing: steps_per_epoch=10 with K=4
     runs dispatches of 4, 4, 2 — the save_freq=5 boundary falls INSIDE

@@ -215,3 +215,136 @@ def test_kv_cache_dtype_follows_precision_policy():
     # And the policy engine still serves end-to-end.
     out = ebf.run([Request(np.array([1, 2, 3], np.int32), 3)])[0]
     assert out.shape == (6,) and out.dtype == np.int32
+
+
+# ------------------------------------------- logprobs, RNG, weight swaps --
+@pytest.fixture(scope="module")
+def sampler(lm):
+    """One shared SAMPLING engine (temperature 1): every fresh Engine
+    pays its own prefill/decode compile, so the logprob/RNG tests reuse
+    this one — per-request seeds make their streams independent anyway
+    (that independence is exactly what the tests pin)."""
+    return Engine(lm, max_slots=2, block_size=4, max_len=64,
+                  temperature=1.0, seed=5)
+
+
+def test_logprob_capture_rides_fixed_dispatch_no_recompile(lm, sampler):
+    """return_logprobs toggling is pure host bookkeeping: the logprobs
+    are computed inside the fixed-shape dispatches either way, so the
+    decode/prefill jit caches must not grow across the toggle — and the
+    captured values must equal teacher-forced log-softmax scores of the
+    served tokens (the trainer's recomputation, see rl.PostTrainer)."""
+    prompts, news = _requests(seed=7, n=2, m_range=(4, 6))
+    reqs = lambda: [Request(p, m, seed=i)
+                    for i, (p, m) in enumerate(zip(prompts, news))]
+    outs = sampler.run(reqs(), return_logprobs=True)
+    decode_compiles = sampler._decode_jit._cache_size()
+    prefill_compiles = sampler._prefill_jit._cache_size()
+    rows_by_order = sampler.last_run_telemetry["requests"]  # submit order
+    # Teacher-force both served rows in ONE padded predict (one compile):
+    # captured logprob == log_softmax of the model's logits at the
+    # sampled token (temperature 1).
+    pad_to = max(o.size for o in outs)
+    batch = np.zeros((len(outs), pad_to - 1), np.int32)
+    for i, o in enumerate(outs):
+        batch[i, : o.size - 1] = o[:-1]
+    logits = lm.predict(batch, batch_size=len(outs))
+    refs = jax.nn.log_softmax(jnp.asarray(logits, jnp.float32), -1)
+    for i, (p, out, row) in enumerate(zip(prompts, outs, rows_by_order)):
+        lps = row["logprobs"]
+        assert len(lps) == out.size - p.size
+        for t in range(p.size - 1, out.size - 1):
+            want = float(refs[i, t, out[t + 1]])
+            got = lps[t - (p.size - 1)]
+            assert abs(want - got) < 1e-4, (t, want, got)
+    # Toggling capture OFF reuses the exact same compiled programs.
+    sampler.run(reqs())
+    assert sampler._decode_jit._cache_size() == decode_compiles
+    assert sampler._prefill_jit._cache_size() == prefill_compiles
+    assert "logprobs" not in sampler.last_run_telemetry["requests"][0]
+
+
+def test_sampled_decode_deterministic_across_slots_and_runs(lm, sampler):
+    """The serving analogue of the greedy token-exact discipline: with
+    per-request seeds, sampled rollouts are bit-identical across engine
+    shapes (a different max_slots changes scheduling entirely), across
+    repeat runs, and sensitive to the request seed (distinct streams)."""
+    prompts, news = _requests(seed=9, n=4, m_range=(5, 8))
+
+    def serve(engine, base_seed=100):
+        return engine.run([Request(p, m, seed=base_seed + i)
+                           for i, (p, m) in enumerate(zip(prompts, news))])
+
+    narrow = Engine(lm, max_slots=1, block_size=4, max_len=64,
+                    temperature=1.0, seed=5)
+    a, b, c = serve(narrow), serve(sampler), serve(sampler)
+    for i, (x, y, z) in enumerate(zip(a, b, c)):
+        np.testing.assert_array_equal(x, y, err_msg=f"slots 1 vs 2, req {i}")
+        np.testing.assert_array_equal(y, z, err_msg=f"rerun, req {i}")
+    # Different request seeds are different sampling streams.
+    d = serve(sampler, base_seed=900)
+    assert any(not np.array_equal(x, y) for x, y in zip(a, d))
+
+
+def test_update_weights_staleness_contract(lm):
+    """A sequence straddling a hot-swap keeps its KV and finishes, with
+    the weights_version boundary recorded per token row. Swapping in
+    value-identical params mid-run must leave greedy tokens exactly equal
+    to the unswapped run (KV retained, no hidden reset); the jit cache
+    must not grow (same shapes/dtypes => no retrace)."""
+    prompts, news = _requests(seed=4, n=1, p_range=(4, 5), m_range=(8, 9))
+    engine = Engine(lm, max_slots=1, block_size=4, max_len=64)
+    base = engine.run([Request(prompts[0], news[0])])[0]
+    compiles = engine._decode_jit._cache_size()
+    same = jax.tree_util.tree_map(lambda a: a, lm.params)
+
+    def swap(eng, step):
+        if step == 3:
+            eng.update_weights(same)
+
+    out = engine.run([Request(prompts[0], news[0])], on_decode_step=swap)[0]
+    np.testing.assert_array_equal(base, out)
+    assert engine._decode_jit._cache_size() == compiles
+    row = engine.last_run_telemetry["requests"][0]
+    # Prefill token + 3 decode tokens under v0, the rest under v1.
+    assert row["weights_versions"] == [
+        {"version": 0, "tokens": 4},
+        {"version": 1, "tokens": news[0] - 4},
+    ]
+    assert engine.last_run_telemetry["weight_swaps"] == 1
+    assert engine.weights_version == 1
+    assert engine.kv.live_blocks == 0  # the straddler finished cleanly
+    # Genuinely new weights mid-run: sequence still completes, and the
+    # engine keeps serving them (version sticks) on the next run.
+    bumped = jax.tree_util.tree_map(
+        lambda a: a + 0.05 * jnp.ones_like(a), lm.params
+    )
+
+    def swap2(eng, step):
+        if step == 2:
+            eng.update_weights(bumped)
+
+    out2 = engine.run([Request(prompts[0], news[0])], on_decode_step=swap2)[0]
+    assert out2.shape == base.shape
+    assert engine.weights_version == 2
+    after = engine.run([Request(prompts[0], news[0])])[0]
+    spans = engine.last_run_telemetry["requests"][0]["weights_versions"]
+    assert spans == [{"version": 2, "tokens": news[0]}]
+    assert not np.array_equal(after, base)  # bumped weights really serve
+
+
+def test_update_weights_validates_loudly(lm):
+    engine = Engine(lm, max_slots=1, block_size=4, max_len=64)
+    with pytest.raises(ValueError, match="structure"):
+        engine.update_weights({"bogus": np.zeros((2, 2), np.float32)})
+    wrong_shape = jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape + (1,), np.float32), lm.params
+    )
+    with pytest.raises(ValueError, match="shape mismatch"):
+        engine.update_weights(wrong_shape)
+    wrong_dtype = jax.tree_util.tree_map(
+        lambda a: np.zeros(a.shape, np.float16), lm.params
+    )
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        engine.update_weights(wrong_dtype)
+    assert engine.weights_version == 0  # failed swaps change nothing
