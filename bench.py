@@ -1487,6 +1487,242 @@ def bench_elastic(steps=10, global_batch=64):
     }
 
 
+# -------------------------------------------------------------- recovery ----
+_RECOVERY_WORKER = """
+import os, sys, time
+sys.path.insert(0, os.environ["BENCH_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import distributed_tpu as dtpu
+from distributed_tpu.data.pipeline import Pipeline
+from distributed_tpu.launch import report_result
+from distributed_tpu.resilience import FaultInjector
+from distributed_tpu.training.callbacks import LambdaCallback, ModelCheckpoint
+from distributed_tpu.utils import events
+
+spec = dtpu.cluster.initialize()
+world = spec.num_processes
+attempt = int(os.environ.get("DTPU_ATTEMPT", "1"))
+GB = int(os.environ["BENCH_GB"])
+STEPS = int(os.environ["BENCH_STEPS"])
+WIDTH = int(os.environ["BENCH_WIDTH"])
+refresh = int(os.environ.get("BENCH_REFRESH_EVERY", "1"))
+record_loss = os.environ.get("BENCH_RECORD_LOSS") == "1"
+
+x, y = dtpu.data.synthetic_images(256, (8, 8), 10, 0)
+# FSDP so each worker's state shard is genuinely 1/N-sized (the (1+1/N)x
+# redundancy story); single-process falls back to the whole tree.
+strategy = (dtpu.FullyShardedDataParallel() if world > 1
+            else dtpu.SingleDevice())
+with strategy.scope():
+    m = dtpu.Model(dtpu.nn.Sequential([
+        dtpu.nn.Flatten(),
+        dtpu.nn.Dense(WIDTH, activation="relu"),
+        dtpu.nn.Dense(WIDTH, activation="relu"),
+        dtpu.nn.Dense(10),
+    ]))
+    m.compile(optimizer=dtpu.optim.SGD(0.05, momentum=0.9),
+              loss="sparse_categorical_crossentropy")
+m.build((8, 8))
+
+seen_first = []
+def on_step(model, step, logs):
+    if not seen_first:
+        seen_first.append(step)
+        events.emit("first_step", attempt=attempt, step=int(step),
+                    world=world)
+    if spec.index == 0 and record_loss:
+        events.emit("step_mark", attempt=attempt, world=world,
+                    step=int(step), loss=float(logs["loss"]))
+
+# buddy=True arms the diskless tier from the supervisor-exported
+# DTPU_BUDDY_STORE; refresh cadence 10**9 leaves the tier armed for
+# restore-tier SELECTION (and its telemetry events) but never refreshed —
+# the disk-tier baseline runs through the identical code path.
+cbs = [ModelCheckpoint(os.environ["BENCH_CKPT"], sharded=True,
+                       save_freq=int(os.environ.get("BENCH_SAVE_FREQ", "2")),
+                       restore=True,
+                       async_save=os.environ.get("BENCH_SYNC_SAVE") != "1",
+                       buddy=True,
+                       buddy_refresh_every=(refresh if refresh > 0
+                                            else 10**9)),
+       LambdaCallback(on_batch_end=on_step)]
+fault = FaultInjector.from_env()
+if fault is not None:
+    cbs.append(fault)
+
+with Pipeline(x, y, GB, seed=0, use_native=False,
+              shard=(spec.index, world)) as p:
+    m.fit(p, epochs=1, steps_per_epoch=STEPS, verbose=0, callbacks=cbs)
+
+red = (m.last_fit_telemetry or {}).get("redundancy")
+report_result({"world": world, "final_step": int(m.step),
+               "redundancy": red})
+"""
+
+
+def _recovery_gang(tmp, *, world=2, width=2560, steps=8,
+                   fault="kill:at_step=5,rank=1", once=True,
+                   refresh_every=1, save_freq=2, global_batch=32,
+                   record_loss=False, sync_save=False, max_restarts=3,
+                   timeout=600.0, grace=5.0):
+    """One supervised diskless-recovery scenario (shared by ``bench.py
+    recovery`` and the tests/test_redundancy.py fault matrix): a
+    fixed-size FSDP gang with sharded async checkpoints AND the buddy
+    tier armed (``refresh_every=0`` arms selection but never refreshes —
+    the disk-tier baseline), fault-injected per ``fault``. The supervisor
+    owns a tmpfs buddy store and invalidates failed ranks' segments, so
+    the relaunch's restore-tier selection sees exactly what a host loss
+    leaves behind. Returns (SupervisedResult, events, store_root) — the
+    caller removes ``store_root``."""
+    import os
+    from pathlib import Path
+
+    from distributed_tpu.resilience import (
+        RestartPolicy, Supervisor, ram_dir,
+    )
+    from distributed_tpu.utils.events import EventLog
+
+    tmp = Path(tmp)
+    tmp.mkdir(parents=True, exist_ok=True)
+    worker = tmp / "worker.py"
+    worker.write_text(_RECOVERY_WORKER)
+    log = EventLog(tmp / "events.jsonl")
+    store_root = ram_dir()
+    env_extra = {
+        "BENCH_REPO": os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_CKPT": str(tmp / "ckpt"),
+        "BENCH_GB": str(global_batch),
+        "BENCH_STEPS": str(steps),
+        "BENCH_WIDTH": str(width),
+        "BENCH_SAVE_FREQ": str(save_freq),
+        "BENCH_REFRESH_EVERY": str(refresh_every),
+    }
+    if record_loss:
+        env_extra["BENCH_RECORD_LOSS"] = "1"
+    if sync_save:
+        env_extra["BENCH_SYNC_SAVE"] = "1"
+    if fault:
+        env_extra["DTPU_FAULT"] = fault
+        if once:
+            env_extra["DTPU_FAULT_MARKER"] = str(tmp / "fault_once")
+    sup = Supervisor(
+        [sys.executable, str(worker)], world,
+        policy=RestartPolicy(max_restarts=max_restarts, backoff=0.01,
+                             backoff_max=0.01),
+        checkpoint_dir=tmp / "ckpt",
+        buddy_store_dir=store_root,
+        event_log=log,
+        env_extra=env_extra,
+    )
+    result = sup.run(timeout=timeout, grace=grace)
+    return result, log.read(), store_root
+
+
+def _recovery_row(events):
+    """The first recovery's MTTR breakdown row from a run's events."""
+    return next((e for e in events if e["event"] == "recovery"), None)
+
+
+def _median(values):
+    vals = [v for v in values if v is not None]
+    return round(float(np.median(vals)), 4) if vals else None
+
+
+def bench_recovery(width=2560, steps=8, kill_step=5, repeats=3):
+    """Diskless-recovery payoff (ROADMAP item 5, docs/RESILIENCE.md
+    "Recovery tiers"): the SAME supervised kill-and-restart gang protocol
+    as ``bench.py resilience``/``elastic`` — 2 FSDP workers, rank 1
+    killed once mid-run — recovered through (a) the BUDDY tier (per-step
+    in-RAM mirror refresh; the relaunch restores the gang's state from
+    tmpfs mirrors, zero disk-block reads, asserted from the
+    ``restore_end`` event counters) and (b) the DISK tier (identical run
+    with refreshes disabled: the sharded checkpoint restores). Reported
+    per tier, median of ``repeats`` supervised runs: the restore seconds
+    (the component the tier changes), the full
+    detect/gang-reform/restore/recompile MTTR breakdown from the
+    supervisor's ``recovery`` events, and restore-to-first-step for
+    comparison with BENCH_elastic.json's 4.0s disk-path row. Artifact:
+    BENCH_recovery.json."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    tmp = Path(tempfile.mkdtemp(prefix="dtpu_bench_recovery_"))
+    fault = f"kill:at_step={kill_step},rank=1"
+
+    def run_tier(name, refresh_every, i):
+        res, events, store = _recovery_gang(
+            tmp / f"{name}{i}", width=width, steps=steps, fault=fault,
+            refresh_every=refresh_every,
+        )
+        row = _recovery_row(events)
+        shutil.rmtree(store, ignore_errors=True)
+        return res, row
+
+    tiers = {}
+    ok = True
+    for name, refresh_every in (("buddy", 1), ("disk", 0)):
+        rows, oks = [], []
+        for i in range(max(1, repeats)):
+            res, row = run_tier(name, refresh_every, i)
+            oks.append(res.ok and row is not None)
+            if row is not None:
+                rows.append(row)
+        ok = ok and all(oks)
+        tiers[name] = {
+            "ok": all(oks),
+            "rows": rows,
+            "restore_s_median": _median([r["restore_s"] for r in rows]),
+            "restore_to_first_step_s_median": _median(
+                [r["total_to_first_step_s"] for r in rows]),
+            "gang_reform_s_median": _median(
+                [r["gang_reform_s"] for r in rows]),
+            "recompile_s_median": _median([r["recompile_s"] for r in rows]),
+            "tiers_used": sorted({r["restore_tier"] for r in rows}),
+            "disk_block_reads": [r["disk_block_reads"] for r in rows],
+        }
+
+    buddy, disk = tiers["buddy"], tiers["disk"]
+    zero_disk = all(n == 0 for n in buddy["disk_block_reads"])
+    restore_speedup = (
+        round(disk["restore_s_median"] / buddy["restore_s_median"], 2)
+        if buddy["restore_s_median"] and disk["restore_s_median"] else None
+    )
+    ok = bool(
+        ok
+        and buddy["tiers_used"] == ["buddy"]
+        and disk["tiers_used"] == ["disk"]
+        and zero_disk
+        and buddy["restore_s_median"] < disk["restore_s_median"]
+    )
+    return {
+        "metric": "recovery_buddy_restore_to_first_step_seconds",
+        "value": buddy["restore_to_first_step_s_median"],
+        "unit": "s",
+        "ok": ok,
+        "buddy": buddy,
+        "disk": disk,
+        "restore_speedup_buddy_over_disk": restore_speedup,
+        "zero_disk_block_reads_on_buddy_path": zero_disk,
+        "disk_baseline_elastic_json": 4.0,
+        "model": f"dense {width}x{width} MLP, FSDP over 2 procs, "
+                 "SGD+momentum",
+        "note": "same supervised XLA:CPU 2-worker gang protocol as "
+                "bench.py resilience/elastic (1-core box: latencies span "
+                "process spawn, jax init, gang formation, restore, jit "
+                "recompile; CPU-transport caveat per docs/PERF.md). The "
+                "tier changes the RESTORE component: buddy restores the "
+                "whole gang state from committed tmpfs mirrors (mmap'd "
+                "raw blocks, zero disk-block reads, counters asserted), "
+                "disk restores the sharded npz checkpoint. MTTR rows "
+                "from the supervisor's recovery events (median of "
+                f"{repeats} supervised runs per tier).",
+    }
+
+
 # ------------------------------------------------------------ long context --
 def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
                            (1, 8192, True), (1, 16384, True),
@@ -2367,7 +2603,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
     known = {"mnist", "multistep", "overlap", "input", "convergence",
              "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
              "precision", "compile_cache", "serve", "elastic", "quant",
-             "fused_update", "autoshard", "fleet", "rl"}
+             "fused_update", "autoshard", "fleet", "rl", "recovery"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -2428,6 +2664,11 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # Opt-in: elastic gang 4->2->4 resize-to-first-step latency
         # (BENCH_elastic.json; docs/RESILIENCE.md "Elastic gangs").
         extra.append(bench_elastic())
+    if "recovery" in modes:
+        # Opt-in: diskless buddy-tier vs disk-tier recovery on the
+        # supervised-gang protocol (BENCH_recovery.json;
+        # docs/RESILIENCE.md "Recovery tiers").
+        extra.append(bench_recovery())
     if "quant" in modes:
         # Opt-in: int8 weight-only serving bytes + decode fidelity + FSDP
         # gather accounting (BENCH_quant.json; docs/PERF.md "Quantization

@@ -8,11 +8,12 @@ from .core import (
     save_npz,
     wait_all_async,
 )
-from .sharded import ShardedCheckpointer
+from .sharded import ShardCorruptionError, ShardedCheckpointer
 
 __all__ = [
     "Checkpointer",
     "ShardedCheckpointer",
+    "ShardCorruptionError",
     "wait_all_async",
     "save_npz",
     "load_npz",

@@ -66,6 +66,14 @@ def main(argv=None):
                          "world size down to a divisor of this — set it to "
                          "the global batch size so resizes keep exact "
                          "batch math")
+    ap.add_argument("--buddy-store-dir", type=str, default=None,
+                    help="(with --supervise) RAM-backed buddy-redundancy "
+                         "store dir (tmpfs, e.g. under /dev/shm): exported "
+                         "to workers as DTPU_BUDDY_STORE so "
+                         "ModelCheckpoint(buddy=True) arms the diskless "
+                         "recovery tier; the supervisor invalidates failed "
+                         "ranks' segments before each relaunch "
+                         "(docs/RESILIENCE.md 'Recovery tiers')")
     ap.add_argument("--event-log", type=str, default=None,
                     help="(with --supervise) JSONL event log path; also "
                          "exported to workers as DTPU_EVENT_LOG")
@@ -102,6 +110,7 @@ def main(argv=None):
             policy=RestartPolicy(max_restarts=args.max_restarts or 3),
             elastic=elastic,
             checkpoint_dir=args.checkpoint_dir,
+            buddy_store_dir=args.buddy_store_dir,
             event_log=EventLog(args.event_log) if args.event_log else None,
             liveness_timeout=args.liveness_timeout,
         )

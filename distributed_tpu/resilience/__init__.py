@@ -36,12 +36,26 @@ from .preemption import (
     read_resume_marker,
     write_resume_marker,
 )
-from .supervisor import SupervisedResult, Supervisor, supervise
+from .redundancy import (
+    BuddyRedundancy,
+    BuddyStore,
+    mirror_holder,
+    mirror_source,
+    ram_dir,
+    select_restore_tier,
+)
+from .supervisor import (
+    SupervisedResult,
+    Supervisor,
+    recovery_rows,
+    supervise,
+)
 
 __all__ = [
     "Supervisor",
     "SupervisedResult",
     "supervise",
+    "recovery_rows",
     "RestartPolicy",
     "ElasticPolicy",
     "FailureLedger",
@@ -49,6 +63,12 @@ __all__ = [
     "PREEMPTED_EXIT_CODE",
     "FaultInjector",
     "corrupt_latest_checkpoint",
+    "BuddyRedundancy",
+    "BuddyStore",
+    "select_restore_tier",
+    "mirror_holder",
+    "mirror_source",
+    "ram_dir",
     "EventLog",
     "read_events",
     "write_resume_marker",

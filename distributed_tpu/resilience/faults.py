@@ -22,6 +22,20 @@ worker fail in a chosen mode at a chosen step, once:
   tears that replica down mid-request — the failure the router's
   requeue path exists for (docs/SERVING.md "Fleet"). Fleet-driven, not
   training-driven: ``on_batch_end`` ignores this mode.
+- ``buddy_kill``: kill a worker AND its ring mirror holder
+  (``rank`` and ``(rank+1) % world``) in the same step — the buddy-PAIR
+  loss that takes out a shard's live copy and its only in-memory mirror
+  together, forcing the recovery-tier selection down to the disk
+  checkpoint (docs/RESILIENCE.md "Recovery tiers"). Uses per-rank once
+  markers so both pair members fire exactly once each.
+- ``kill_during_refresh``: die MID buddy-refresh — after the worker's
+  ``self`` mirror commit, before the ``peer`` push commits
+  (``redundancy.BuddyRedundancy.refresh`` calls
+  :func:`fire_refresh_kill` in that window). The surviving mirror set is
+  torn/stale, which the restore-tier selection must reject in favor of
+  the disk tier. Refresh-driven, not step-driven: ``on_batch_end``
+  ignores this mode; arming happens via callback registration at
+  ``on_train_begin``.
 
 ``once_marker`` (a file path) arms the fault for the FIRST attempt only:
 the restarted worker sees the marker and trains through — exactly the
@@ -46,26 +60,61 @@ ENV_VAR = "DTPU_FAULT"
 MARKER_ENV_VAR = "DTPU_FAULT_MARKER"
 
 MODES = ("kill", "hang", "slow_heartbeat", "corrupt_checkpoint",
-         "replica_kill")
+         "replica_kill", "buddy_kill", "kill_during_refresh")
+
+# kill_during_refresh arming: injectors register here at on_train_begin
+# and the buddy-refresh writer polls fire_refresh_kill() mid-refresh.
+# Module-level (not plumbed through BuddyRedundancy) so worker scripts
+# arm it with the same one-line FaultInjector.from_env() as every other
+# mode; deregistered at on_train_end so in-process tests can't leak an
+# armed kill into a later fit.
+_REFRESH_FAULTS: list = []
+
+
+def fire_refresh_kill(step: int) -> None:
+    """The mid-refresh fault hook: called by
+    ``redundancy.BuddyRedundancy.refresh`` between the ``self`` mirror
+    commit and the ``peer`` push. Kills the process iff an armed
+    ``kill_during_refresh`` injector matches (rank, step, once-marker) —
+    same semantics as the step-boundary faults, different trigger
+    point."""
+    for inj in tuple(_REFRESH_FAULTS):
+        inj._maybe_refresh_kill(int(step))
 
 
 def corrupt_latest_checkpoint(directory) -> Optional[Path]:
-    """Overwrite the newest ``ckpt-*.npz`` with garbage (not a zip, and
-    shorter than the original — a torn write) and leave the latest-pointer
-    aimed at it, simulating a crash mid-save that the pointer's atomic
-    rename alone cannot guard against. Returns the corrupted path, or None
-    when the directory holds no checkpoints."""
+    """Overwrite the newest checkpoint with garbage (not a zip, and
+    shorter than the original — a torn write), simulating a crash
+    mid-save that atomic renames alone cannot guard against. Handles both
+    flavors: the newest ``ckpt-*.npz`` (``Checkpointer``; the latest
+    pointer is left aimed at it), or — when the directory holds sharded
+    ``ckpt-<step>/`` dirs instead — a shard file of the newest COMMITTED
+    step (its manifest already promises the file, so restore must detect
+    the damage, not re-classify the step as an aborted save). Returns the
+    corrupted path, or None when the directory holds no checkpoints."""
     directory = Path(directory)
     steps = []
     for p in directory.glob("ckpt-*.npz"):
         m = re.fullmatch(r"ckpt-(\d+)\.npz", p.name)
         if m:
             steps.append((int(m.group(1)), p))
-    if not steps:
+    if steps:
+        _, path = max(steps)
+        path.write_bytes(b"\x00not-a-zip\x00" * 3)
+        return path
+    sharded = []
+    for p in directory.glob("ckpt-*"):
+        m = re.fullmatch(r"ckpt-(\d+)", p.name)
+        if m and (p / "manifest.json").exists():
+            sharded.append((int(m.group(1)), p))
+    if not sharded:
         return None
-    _, path = max(steps)
-    path.write_bytes(b"\x00not-a-zip\x00" * 3)
-    return path
+    _, step_dir = max(sharded)
+    shard = sorted(step_dir.glob("proc-*.npz"))
+    if not shard:
+        return None
+    shard[0].write_bytes(b"\x00not-a-zip\x00" * 3)
+    return shard[0]
 
 
 class FaultInjector(Callback):
@@ -92,6 +141,11 @@ class FaultInjector(Callback):
             raise ValueError(
                 "replica_kill mode needs replica= (the pool-member name, "
                 "e.g. 'decode-1', that the fleet should tear down)"
+            )
+        if mode in ("buddy_kill", "kill_during_refresh") and rank is None:
+            raise ValueError(
+                f"{mode} mode needs a concrete rank= (the shard owner the "
+                "fault targets); rank='all' has no buddy-pair meaning"
             )
         self.mode = mode
         self.at_step = int(at_step)
@@ -133,17 +187,67 @@ class FaultInjector(Callback):
             kw["once_marker"] = marker
         return cls(mode.strip(), **kw)
 
+    def _marker_path(self) -> Optional[Path]:
+        """The once-marker this PROCESS checks/touches. buddy_kill kills a
+        PAIR of ranks, each of which must fire exactly once — a shared
+        marker would let whichever pair member fires first disarm the
+        other — so the marker is suffixed per rank for that mode."""
+        if self.once_marker is None:
+            return None
+        if self.mode != "buddy_kill":
+            return self.once_marker
+        import jax
+
+        return self.once_marker.with_name(
+            self.once_marker.name + f".rank{jax.process_index()}"
+        )
+
     def _armed(self) -> bool:
         if self.fired:
             return False
-        if self.once_marker is not None and self.once_marker.exists():
+        marker = self._marker_path()
+        if marker is not None and marker.exists():
             return False
         if self.rank is not None:
             import jax
 
-            if jax.process_index() != self.rank:
+            me = jax.process_index()
+            if self.mode == "buddy_kill":
+                # The targeted shard owner AND its ring mirror holder
+                # ((rank+1) % world, see resilience.redundancy) die
+                # together: the buddy-pair loss.
+                world = jax.process_count()
+                if me not in (self.rank % world, (self.rank + 1) % world):
+                    return False
+            elif me != self.rank:
                 return False
         return True
+
+    # ---------------------------------------------------- refresh trigger --
+    def on_train_begin(self, model):
+        if self.mode == "kill_during_refresh" and self not in _REFRESH_FAULTS:
+            _REFRESH_FAULTS.append(self)
+
+    def on_train_end(self, model, history):
+        if self in _REFRESH_FAULTS:
+            _REFRESH_FAULTS.remove(self)
+
+    def _maybe_refresh_kill(self, step: int) -> None:
+        """Called (via :func:`fire_refresh_kill`) from the buddy-refresh
+        writer, mid-refresh. Same arming rules as the step faults; the
+        ``os._exit`` may run on the writer thread — it kills the whole
+        process either way, which is the point."""
+        if self.mode != "kill_during_refresh" or step < self.at_step:
+            return
+        if not self._armed():
+            return
+        self.fired = True
+        marker = self._marker_path()
+        if marker is not None:
+            marker.parent.mkdir(parents=True, exist_ok=True)
+            marker.touch()
+        events_lib.emit("fault_injected", mode=self.mode, step=int(step))
+        os._exit(self.exit_code)
 
     def should_kill_replica(self, name: str, step: int) -> bool:
         """Fleet-facing trigger: True exactly once, when ``name`` matches
@@ -170,14 +274,17 @@ class FaultInjector(Callback):
     def on_batch_end(self, model, step, logs):
         if self.mode == "replica_kill":
             return  # fleet-driven (should_kill_replica), not training-driven
+        if self.mode == "kill_during_refresh":
+            return  # refresh-driven (fire_refresh_kill), not step-driven
         if step < self.at_step or not self._armed():
             return
         self.fired = True
-        if self.once_marker is not None:
-            self.once_marker.parent.mkdir(parents=True, exist_ok=True)
-            self.once_marker.touch()
+        marker = self._marker_path()
+        if marker is not None:
+            marker.parent.mkdir(parents=True, exist_ok=True)
+            marker.touch()
         events_lib.emit("fault_injected", mode=self.mode, step=int(step))
-        if self.mode == "kill":
+        if self.mode in ("kill", "buddy_kill"):
             os._exit(self.exit_code)
         elif self.mode == "hang":
             # Frozen, not dead: exit-code monitoring sees nothing; only the

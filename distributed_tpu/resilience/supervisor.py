@@ -46,6 +46,79 @@ from .preemption import (
 # Mirrors cluster.init.ELASTIC_WORLD_ENV (not imported: cluster.init pulls
 # in jax, and the supervisor must stay importable on jax-free controllers).
 ELASTIC_WORLD_ENV = "DTPU_ELASTIC_WORLD"
+# Mirrors redundancy.ENV_VAR (same jax-free-controller rule; the
+# BuddyStore class itself is jax-free and imported lazily where needed).
+BUDDY_STORE_ENV = "DTPU_BUDDY_STORE"
+
+
+def recovery_rows(events: Sequence[dict]) -> List[dict]:
+    """Per-recovery MTTR breakdown from a supervised run's event records:
+    one row per failed attempt whose successor relaunched, splitting the
+    recovery into
+
+    - ``detect_s``      — injected fault (``fault_injected``) to the
+      launcher declaring the attempt dead (``attempt_end``); None for
+      organic failures with no fault event.
+    - ``gang_reform_s`` — attempt end to the relaunched gang opening its
+      restore (``restore_begin``): process spawn, imports, jax init,
+      gang formation.
+    - ``restore_s``     — ``restore_begin`` to ``restore_end`` (which
+      carries the tier used and the disk blocks read).
+    - ``recompile_s``   — ``restore_end`` to the first completed
+      optimizer step (``post_restore_step``): jit recompile + first
+      dispatch.
+
+    Worker-side events are filtered to rank 0 (every rank restores; one
+    timeline per recovery). Fields are None when the corresponding events
+    are absent — a worker without ``ModelCheckpoint(restore=True)`` emits
+    no restore markers, and the row then only attributes what it can.
+    The supervisor emits each row as a ``recovery`` event at run end, so
+    BENCH_recovery.json and user telemetry attribute recovery time
+    honestly instead of reporting one opaque restart latency."""
+
+    def _rank0(e):
+        return e.get("rank") in (None, 0)
+
+    ends = {e.get("attempt"): e for e in events
+            if e["event"] == "attempt_end" and not e.get("ok", True)}
+    starts = {e.get("attempt"): e for e in events
+              if e["event"] == "attempt_start"}
+    rows: List[dict] = []
+    for attempt in sorted(a for a in ends if a is not None):
+        nxt = attempt + 1
+        if nxt not in starts:
+            continue
+        t_fail = ends[attempt]["ts"]
+        t_next_end = ends.get(nxt, {}).get("ts", float("inf"))
+        window = [e for e in events
+                  if starts[nxt]["ts"] <= e["ts"] <= t_next_end]
+        fault = max((e for e in events
+                     if e["event"] == "fault_injected" and e["ts"] <= t_fail),
+                    key=lambda e: e["ts"], default=None)
+        rb = next((e for e in window
+                   if e["event"] == "restore_begin" and _rank0(e)), None)
+        re_ = next((e for e in window
+                    if e["event"] == "restore_end" and _rank0(e)), None)
+        ps = next((e for e in window
+                   if e["event"] == "post_restore_step" and _rank0(e)), None)
+        first = next((e for e in window if e["event"] == "first_step"), None)
+
+        def span(a, b):
+            return round(b["ts"] - a["ts"], 4) if (a and b) else None
+
+        rows.append({
+            "failed_attempt": attempt,
+            "recovered_attempt": nxt,
+            "detect_s": span(fault, ends[attempt]),
+            "gang_reform_s": span(ends[attempt], rb),
+            "restore_s": span(rb, re_),
+            "recompile_s": span(re_, ps),
+            "restore_tier": (re_ or {}).get("tier"),
+            "restore_step": (re_ or {}).get("step"),
+            "disk_block_reads": (re_ or {}).get("disk_block_reads"),
+            "total_to_first_step_s": span(ends[attempt], ps or first),
+        })
+    return rows
 
 
 @dataclasses.dataclass
@@ -146,6 +219,7 @@ class Supervisor:
         policy: Optional[RestartPolicy] = None,
         elastic: Optional[ElasticPolicy] = None,
         checkpoint_dir=None,
+        buddy_store_dir=None,
         event_log: Optional[events_lib.EventLog] = None,
         env_extra: Optional[Dict[str, str]] = None,
         liveness_timeout: Optional[float] = None,
@@ -157,6 +231,14 @@ class Supervisor:
         self.policy = policy or RestartPolicy()
         self.elastic = elastic
         self.checkpoint_dir = checkpoint_dir
+        # Diskless-recovery tier (docs/RESILIENCE.md "Recovery tiers"):
+        # when set, workers learn the RAM store via DTPU_BUDDY_STORE
+        # (ModelCheckpoint(buddy=True) arms itself from it), and the
+        # supervisor models per-host memory loss: before each relaunch it
+        # drops the store segments of ranks that INITIATED the failure —
+        # a crashed worker's resident mirrors did not survive it, while
+        # gang-killed collateral peers (healthy hosts) keep theirs.
+        self.buddy_store_dir = buddy_store_dir
         self.event_log = event_log
         self.env_extra = dict(env_extra or {})
         self.liveness_timeout = liveness_timeout
@@ -181,6 +263,8 @@ class Supervisor:
     def _attempt_env(self, attempt: int, world: int) -> Dict[str, str]:
         env = dict(self.env_extra)
         env["DTPU_ATTEMPT"] = str(attempt)
+        if self.buddy_store_dir is not None:
+            env[BUDDY_STORE_ENV] = str(self.buddy_store_dir)
         if self.elastic is not None:
             # The relaunched workers must form a clean N'-process runtime
             # even when a stale N-worker spec is inherited from the
@@ -336,6 +420,7 @@ class Supervisor:
             if not failed:
                 if self.checkpoint_dir is not None:
                     clear_resume_marker(self.checkpoint_dir)
+                self._emit_recoveries()
                 self._emit("run_complete", attempts=attempt,
                            restarts_used=restarts_used,
                            preemptions=preemptions, resizes=resizes,
@@ -347,6 +432,7 @@ class Supervisor:
                                              failed, ledger, resizes)
             if preempted and self.policy.preemption_exempt:
                 if not self.policy.allows_preemption_restart(preemptions):
+                    self._emit_recoveries()
                     self._emit("preemption_cap_exhausted",
                                preemptions=preemptions)
                     dlog.warning(
@@ -364,6 +450,7 @@ class Supervisor:
                 delay, reason = 0.0, "resize"
             else:
                 if not self.policy.allows_restart(restarts_used):
+                    self._emit_recoveries()
                     self._emit("budget_exhausted",
                                restarts_used=restarts_used,
                                max_restarts=self.policy.max_restarts)
@@ -391,6 +478,13 @@ class Supervisor:
                 )
                 self._apply_resize(world, new_world, info["lost_ranks"])
                 world = new_world
+            if not preempted:
+                # A rank that initiated the failure lost its host memory;
+                # its buddy-store segment (its own shard's RAM copy + the
+                # ring mirror it held) must not survive into the next
+                # attempt's recovery decision. Preemptions and collateral
+                # gang-kills keep their segments: those hosts are healthy.
+                self._invalidate_buddy_segments(failed)
             resume = self._resume_state()
             self._emit("restart", attempt=attempt + 1, reason=reason,
                        world_size=world, delay=delay,
@@ -408,6 +502,18 @@ class Supervisor:
             if delay > 0:
                 self._sleep(delay)
 
+    def _invalidate_buddy_segments(self, failed: Sequence[WorkerResult]):
+        if self.buddy_store_dir is None:
+            return
+        ranks = sorted({r.index for r in failed if _initiated(r)})
+        if not ranks:
+            return
+        from .redundancy import BuddyStore  # jax-free (plain numpy/files)
+
+        gone = BuddyStore(self.buddy_store_dir).invalidate_ranks(ranks)
+        if gone:
+            self._emit("buddy_segments_invalidated", ranks=gone)
+
     def _resume_state(self) -> Dict[str, Optional[int]]:
         """What the relaunch is expected to resume from: the latest VALID
         checkpoint step (corrupt latest files excluded, same scan restore
@@ -422,6 +528,20 @@ class Supervisor:
             "resume_step": step,
             "marker_step": marker["step"] if marker else None,
         }
+
+    def _emit_recoveries(self):
+        """MTTR telemetry: one `recovery` event per restart boundary with
+        the detect/gang-reform/restore/recompile split and the restore
+        tier used — computed from the run's own event stream right before
+        the terminal event, so post-mortems and bench.py recovery read
+        rows, not raw timestamps."""
+        if self.event_log is None:
+            return
+        try:
+            for row in recovery_rows(self.event_log.read()):
+                self._emit("recovery", **row)
+        except OSError:
+            pass
 
     def _result(self, ok, attempts, restarts_used, preemptions, results,
                 resizes=0, world_size=None):

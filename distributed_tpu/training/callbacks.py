@@ -58,31 +58,51 @@ class ModelCheckpoint(Callback):
     the directory at train begin (no-op when the directory is empty), making
     crash-restart a relaunch of the identical command.
 
-    ``async_save=True`` hands each save to ``Checkpointer``'s background
+    ``async_save=True`` hands each save to the checkpointer's background
     writer: the train loop pays only a device-side snapshot, and the
     fetch/serialize/fsync/pointer-update overlap the following steps. The
-    writer is flushed (``Checkpointer.wait()``) at train end — and by the
-    preemption path before exit 75 — so fit never returns with a write in
-    flight. Time blocked on saves/flushes is attributed to the active
-    fit's ``checkpoint_wait`` stall bucket (``model.last_fit_telemetry``).
+    writer is flushed (``wait()``) at train end — and by the preemption
+    path before exit 75 — so fit never returns with a write in flight.
+    Sharded saves background the same way: the per-process shard write
+    runs on a "dtpu-shard-writer" thread while the cross-host
+    barrier+manifest commit is deferred to the next main-thread
+    ``save()``/``wait()`` (collective-safe; see
+    ``ShardedCheckpointer``). Time blocked on saves/flushes is attributed
+    to the active fit's ``checkpoint_wait`` stall bucket
+    (``model.last_fit_telemetry``).
+
+    ``buddy=`` arms the diskless recovery tier (requires
+    ``sharded=True``): a ``resilience.redundancy.BuddyRedundancy``, a
+    ``BuddyStore``/path to one, or ``True`` to read the
+    supervisor-exported ``DTPU_BUDDY_STORE``. Every
+    ``buddy_refresh_every`` optimizer steps (the same bucket-crossing
+    cadence rule as int ``save_freq``) the worker mirrors its state shard
+    into the RAM store on a background writer; ``restore=True`` then
+    picks the restore tier per recovery — buddy (RAM, zero disk reads)
+    when the mirror set is complete and fresh, the sharded disk
+    checkpoint otherwise, restart-from-scratch with neither — and emits
+    ``restore_begin``/``restore_end``/``post_restore_step`` events so the
+    supervisor's MTTR breakdown can attribute the recovery honestly
+    (docs/RESILIENCE.md "Recovery tiers").
     """
 
     def __init__(self, directory, *, save_freq="epoch", keep: int = 3,
                  restore: bool = False, sharded: bool = False,
-                 async_save: bool = False):
+                 async_save: bool = False, buddy=None,
+                 buddy_refresh_every: int = 1):
         # sharded=True switches to the per-process ShardedCheckpointer
         # (requires a directory shared across hosts; hosts only touch their
         # own shards — the right format for FSDP/TP-scale models).
         if sharded:
-            if async_save:
-                raise ValueError(
-                    "async_save is not supported with sharded=True: the "
-                    "sharded commit is a cross-host barrier, which cannot "
-                    "run on a background thread concurrently with training "
-                    "collectives"
-                )
-            self.ckpt = ShardedCheckpointer(directory, keep=keep)
+            self.ckpt = ShardedCheckpointer(directory, keep=keep,
+                                            async_save=async_save)
         else:
+            if buddy is not None:
+                raise ValueError(
+                    "buddy= needs sharded=True: the mirror encoding is the "
+                    "sharded block layout, and the disk fallback tier is "
+                    "the ShardedCheckpointer"
+                )
             self.ckpt = Checkpointer(directory, keep=keep,
                                      async_save=async_save)
         if save_freq != "epoch" and not (
@@ -92,6 +112,26 @@ class ModelCheckpoint(Callback):
         self.save_freq = save_freq
         self.restore = restore
         self._last_bucket = 0  # save_freq bucket already saved (int freq)
+        # Lazy import: resilience.faults imports this module for the
+        # Callback base, so a top-level import here would cycle.
+        if buddy is None or isinstance(buddy, bool) and not buddy:
+            self._buddy = None
+        else:
+            from ..resilience.redundancy import BuddyRedundancy
+
+            if buddy is True:
+                self._buddy = BuddyRedundancy.from_env()  # None when unset
+            elif isinstance(buddy, BuddyRedundancy):
+                self._buddy = buddy
+            else:  # BuddyStore or path
+                self._buddy = BuddyRedundancy(buddy)
+        if int(buddy_refresh_every) < 1:
+            raise ValueError(
+                f"buddy_refresh_every must be >= 1, got {buddy_refresh_every}"
+            )
+        self.buddy_refresh_every = int(buddy_refresh_every)
+        self._last_refresh_bucket = 0
+        self._post_restore_pending = False  # emit one post_restore_step
 
     def _timed(self, model, fn):
         """Run a (possibly blocking) checkpoint operation, attributing the
@@ -105,8 +145,73 @@ class ModelCheckpoint(Callback):
                 timer.attribute("checkpoint_wait",
                                 time.perf_counter() - t0)
 
+    def _select_tier(self):
+        """(tier, step) for this recovery, agreed gang-wide: the chief's
+        view of the (shared) store + checkpoint directory decides and is
+        broadcast, so every process restores the same tier at the same
+        step (a split decision would desynchronize the gang's collective
+        schedules)."""
+        from ..resilience.redundancy import select_restore_tier
+
+        codes = {"buddy": 0, "disk": 1, "restart": 2}
+        tier, step = select_restore_tier(self._buddy, self.ckpt)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            packed = np.array(
+                [codes[tier], -1 if step is None else int(step)], np.int64
+            )
+            packed = multihost_utils.broadcast_one_to_all(packed)
+            tier = {v: k for k, v in codes.items()}[int(packed[0])]
+            step = None if int(packed[1]) < 0 else int(packed[1])
+        return tier, step
+
+    def _restore_tiered(self, model):
+        """The buddy-aware restore: pick the tier, restore, and emit the
+        MTTR telemetry events the supervisor's recovery breakdown reads
+        (restore_begin / restore_end with tier + disk-block reads; a
+        post_restore_step follows at the first completed optimizer
+        step)."""
+        from ..checkpoint import sharded as sharded_lib
+
+        tier, step = self._select_tier()
+        if tier == "restart":
+            return  # neither tier has state: train from scratch
+        rank = jax.process_index()
+        attempt = os.environ.get("DTPU_ATTEMPT")
+        devents.emit("restore_begin", tier=tier, rank=rank,
+                     attempt=int(attempt) if attempt else None)
+        reads0 = dict(sharded_lib.read_stats)
+        t0 = time.perf_counter()
+        if tier == "buddy":
+            step = self._timed(
+                model, lambda: self._buddy.restore_into(model, step)
+            )
+        else:
+            # restore_into re-runs its own corrupt-skip scan; the step it
+            # lands on (possibly a fallback) is the one reported.
+            step = self._timed(model, lambda: self.ckpt.restore_into(model))
+        devents.emit(
+            "restore_end", tier=tier, step=int(step), rank=rank,
+            seconds=round(time.perf_counter() - t0, 4),
+            disk_block_reads=(sharded_lib.read_stats["block_reads"]
+                              - reads0["block_reads"]),
+            disk_block_bytes=(sharded_lib.read_stats["block_bytes"]
+                              - reads0["block_bytes"]),
+            attempt=int(attempt) if attempt else None,
+        )
+        model._resumed_step = step
+        self._post_restore_pending = True
+        if rank == 0:
+            dlog.info(
+                f"ModelCheckpoint: resumed from step {step} via the "
+                f"{tier} tier"
+            )
+
     def on_train_begin(self, model):
-        if self.restore:
+        if self.restore and self._buddy is not None:
+            self._restore_tiered(model)
+        elif self.restore:
             has_ckpt = self.ckpt.latest_step() is not None
             if jax.process_count() > 1:
                 # Collective decision: without a shared filesystem only the
@@ -135,8 +240,27 @@ class ModelCheckpoint(Callback):
         # time the two rules trigger identically.
         if isinstance(self.save_freq, int):
             self._last_bucket = model.step // self.save_freq
+        # Same crossing rule for the buddy-refresh cadence: a refresh
+        # fires when the step counter CROSSES a cadence boundary (multi-
+        # step execution advances K at a time).
+        if self._buddy is not None:
+            self._last_refresh_bucket = model.step // self.buddy_refresh_every
 
     def on_batch_end(self, model, step, logs):
+        if self._post_restore_pending:
+            # First completed optimizer step after a tiered restore: the
+            # recompile-time marker of the supervisor's MTTR breakdown.
+            self._post_restore_pending = False
+            devents.emit("post_restore_step", step=int(step),
+                         rank=jax.process_index())
+        if self._buddy is not None:
+            bucket = step // self.buddy_refresh_every
+            if bucket > self._last_refresh_bucket:
+                self._last_refresh_bucket = bucket
+                # Async by default: snapshot now, mirror in the background
+                # (the refresh degrades to a warning on failure, never
+                # stops training).
+                self._buddy.refresh(model, step)
         if not isinstance(self.save_freq, int):
             return
         bucket = step // self.save_freq
@@ -149,10 +273,16 @@ class ModelCheckpoint(Callback):
             self._timed(model, lambda: self.ckpt.save(model))
 
     def on_train_end(self, model, history):
-        # Flush the background writer before fit returns: callers read,
+        # Flush the background writers before fit returns: callers read,
         # copy, or restore from the directory immediately after fit, and a
-        # run that exits right after must leave a complete newest step.
+        # run that exits right after must leave a complete newest step
+        # (and a committed newest mirror).
         self._timed(model, self.ckpt.wait)
+        if self._buddy is not None:
+            self._timed(model, self._buddy.wait)
+            # The (1+1/N)x pricing rides the fit telemetry (fit assembles
+            # last_fit_telemetry right after on_train_end).
+            model._redundancy_report = self._buddy.report(model)
 
 
 def _metric_mode(monitor: str) -> str:

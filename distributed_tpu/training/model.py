@@ -1536,6 +1536,13 @@ class Model:
         report["model_state_bytes_per_device"] = tree_bytes_per_device(
             self.params, self.state, self.opt_state
         )["max_bytes_per_device"]
+        # Buddy-redundancy pricing (set by ModelCheckpoint(buddy=...) at
+        # train end): the measured (1+1/N)x of holding a peer's shard
+        # mirror in host RAM, next to the state bytes it insures
+        # (docs/RESILIENCE.md "Recovery tiers").
+        red = getattr(self, "_redundancy_report", None)
+        if red is not None:
+            report["redundancy"] = red
         # Collective-traffic estimate at the dtype the bytes move in: a
         # mixed policy halves FSDP's gathered-param bytes (bf16 vs f32) —
         # the number `bench.py precision` compares across policies.

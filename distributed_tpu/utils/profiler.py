@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import time
+from typing import Optional
 
 import jax
 
@@ -112,6 +113,31 @@ def tree_bytes_per_device(*trees) -> dict:
         "max_bytes_per_device": max(per.values()) if per else 0,
         "total_bytes": sum(per.values()),
         "devices": len(per),
+    }
+
+
+def redundancy_report(state_bytes: int, mirror_host_bytes: int,
+                      world: Optional[int] = None) -> dict:
+    """Price the buddy-redundancy tier's memory overhead, measured not
+    asserted: ``state_bytes`` is this process's resident model state
+    (``tree_bytes_per_device(...)["total_bytes"]`` over its addressable
+    shards of params+state+opt_state) and ``mirror_host_bytes`` the bytes
+    its store segment holds (its own shard's RAM survival copy + the ring
+    buddy's mirror). ``overhead_ratio`` is (state + mirror) / state — for
+    1/N-sized ZeRO/FSDP shards each mirror is 1/N of the model, the
+    (1+1/N)x-flavored pricing the tier's cheapness rests on; replicated
+    strategies pay proportionally more, which this report makes visible
+    instead of hiding (docs/RESILIENCE.md "Recovery tiers"). Rides in
+    ``model.last_fit_telemetry["redundancy"]`` when the tier is armed."""
+    state = int(state_bytes)
+    mirror = int(mirror_host_bytes)
+    return {
+        "state_bytes": state,
+        "mirror_host_bytes": mirror,
+        "overhead_ratio": (
+            round((state + mirror) / state, 4) if state > 0 else None
+        ),
+        "world": int(world) if world is not None else None,
     }
 
 

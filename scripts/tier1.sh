@@ -129,6 +129,22 @@ if [ -n "${TIER1_RL_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_RECOVERY_SMOKE=1: same idea for the diskless-recovery tier —
+# runs the buddy-store/tier-selection/in-process-recovery tests, the
+# sharded-checkpoint CRC+async satellites they build on, and the bench
+# recovery schema smoke (~20 s) so redundancy/restore-path changes
+# iterate fast. The real supervised-gang fault matrix stays @slow (run
+# it with -m slow when touching the gang/invalidation paths; `python
+# bench.py recovery` drives the measured artifact). NOT a tier-1
+# substitute.
+if [ -n "${TIER1_RECOVERY_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_redundancy.py \
+        tests/test_sharded_checkpoint.py \
+        "tests/test_bench.py::test_bench_recovery_schema_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

@@ -256,6 +256,52 @@ def test_bench_fused_update_smoke():
     assert mech["n_param_leaves"] > mech["n_segments"] == 1
 
 
+def test_bench_recovery_schema_smoke(monkeypatch):
+    """Schema + gating smoke for `bench.py recovery` WITHOUT spawning
+    supervised gangs: _recovery_gang is replaced by a synthetic recovery-
+    event factory, so the aggregation (tier medians, zero-disk-read gate,
+    restore-speedup gate) is pinned in milliseconds. The REAL gang paths
+    — buddy restore, pair-loss disk fallback, stale-mirror rejection —
+    are pinned by tests/test_redundancy.py (in-process in tier-1, the
+    subprocess fault matrix @slow), which drive the same _recovery_gang
+    helper this bench uses."""
+
+    class _Res:
+        ok = True
+
+    def fake_gang(tmp, *, refresh_every=1, **kw):
+        buddy = refresh_every > 0
+        row = {
+            "ts": 0.0, "event": "recovery",
+            "failed_attempt": 1, "recovered_attempt": 2,
+            "detect_s": 1.0, "gang_reform_s": 2.0,
+            "restore_s": 0.05 if buddy else 0.25, "recompile_s": 1.1,
+            "restore_tier": "buddy" if buddy else "disk",
+            "restore_step": 4 if buddy else 2,
+            "disk_block_reads": 0 if buddy else 15,
+            "total_to_first_step_s": 3.2 if buddy else 3.4,
+        }
+        return _Res(), [row], str(tmp) + "-store-nonexistent"
+
+    monkeypatch.setattr(bench, "_recovery_gang", fake_gang)
+    out = bench.bench_recovery(repeats=2)
+    assert out["metric"] == "recovery_buddy_restore_to_first_step_seconds"
+    assert out["ok"] is True
+    assert out["buddy"]["restore_s_median"] == 0.05
+    assert out["disk"]["restore_s_median"] == 0.25
+    assert out["restore_speedup_buddy_over_disk"] == 5.0
+    assert out["zero_disk_block_reads_on_buddy_path"] is True
+    assert out["buddy"]["tiers_used"] == ["buddy"]
+    # gates flip honestly: a buddy run that read disk blocks fails
+    def bad_gang(tmp, **kw):
+        res, rows, store = fake_gang(tmp, **kw)
+        rows[0]["disk_block_reads"] = 3
+        return res, rows, store
+
+    monkeypatch.setattr(bench, "_recovery_gang", bad_gang)
+    assert bench.bench_recovery(repeats=1)["ok"] is False
+
+
 def test_bench_output_contract(monkeypatch, capsys):
     """main() prints exactly one JSON line with the driver's schema."""
     monkeypatch.setattr(
