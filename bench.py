@@ -1723,6 +1723,198 @@ def bench_recovery(width=2560, steps=8, kill_step=5, repeats=3):
     }
 
 
+# ------------------------------------------------------------------- obs ----
+_OBS_WORKER = """
+import os, sys
+sys.path.insert(0, os.environ["BENCH_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import distributed_tpu as dtpu
+from distributed_tpu.data.pipeline import Pipeline
+from distributed_tpu.launch import report_result
+from distributed_tpu.resilience import FaultInjector
+
+spec = dtpu.cluster.initialize()
+world = spec.num_processes
+GB = int(os.environ["BENCH_GB"])
+STEPS = int(os.environ["BENCH_STEPS"])
+
+x, y = dtpu.data.synthetic_images(256, (8, 8), 10, 0)
+strategy = dtpu.DataParallel() if world > 1 else dtpu.SingleDevice()
+with strategy.scope():
+    m = dtpu.Model(dtpu.nn.Sequential([
+        dtpu.nn.Flatten(),
+        dtpu.nn.Dense(64, activation="relu"),
+        dtpu.nn.Dense(10),
+    ]))
+    m.compile(optimizer=dtpu.optim.SGD(0.05),
+              loss="sparse_categorical_crossentropy")
+m.build((8, 8))
+cbs = list(filter(None, [FaultInjector.from_env()]))
+with Pipeline(x, y, GB, seed=0, use_native=False,
+              shard=(spec.index, world)) as p:
+    m.fit(p, epochs=1, steps_per_epoch=STEPS, verbose=0, callbacks=cbs)
+report_result({"world": world, "final_step": int(m.step)})
+"""
+
+
+def _obs_gang(tmp, *, world=2, steps=12, global_batch=32, at_step=3,
+              slow_seconds=0.25, threshold=1.5, timeout=600.0, grace=5.0):
+    """One supervised gang with a PERSISTENT slowdown injected on rank 1
+    (``FaultInjector`` mode ``slow_steps``: every step from ``at_step``
+    sleeps ``slow_seconds`` — degraded, not dead) and per-step obs
+    snapshot flushes (``DTPU_OBS_FLUSH_EVERY=1``). The run completes;
+    the supervisor's end-of-run skew aggregation must name rank 1 in a
+    ``straggler`` event. Returns (SupervisedResult, events)."""
+    import os
+    from pathlib import Path
+
+    from distributed_tpu.resilience import RestartPolicy, Supervisor
+    from distributed_tpu.utils.events import EventLog
+
+    tmp = Path(tmp)
+    tmp.mkdir(parents=True, exist_ok=True)
+    worker = tmp / "worker.py"
+    worker.write_text(_OBS_WORKER)
+    log = EventLog(tmp / "events.jsonl")
+    sup = Supervisor(
+        [sys.executable, str(worker)], world,
+        policy=RestartPolicy(max_restarts=1, backoff=0.01, backoff_max=0.01),
+        event_log=log,
+        straggler_threshold=threshold,
+        env_extra={
+            "BENCH_REPO": os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_GB": str(global_batch),
+            "BENCH_STEPS": str(steps),
+            "DTPU_OBS_FLUSH_EVERY": "1",
+            "DTPU_FAULT": (
+                f"slow_steps:at_step={at_step},rank=1,"
+                f"slow_seconds={slow_seconds}"
+            ),
+        },
+    )
+    result = sup.run(timeout=timeout, grace=grace)
+    return result, log.read()
+
+
+def _obs_overhead(global_batch=256, steps=40, windows=5):
+    """Instrumented-vs-bare fit steps/s: the SAME model/data/loop, with
+    the obs runtime on (default) vs ``obs.set_enabled(False)`` (spans
+    degrade to plain timed blocks, registry/flight no-op — the
+    pre-obs loop). Windows are interleaved bare/instrumented so clock
+    drift and cache effects land on both sides; median of ``windows``
+    per side. Positive ``overhead_pct`` = instrumentation cost."""
+    from distributed_tpu import obs
+
+    strategy = _strategy()
+    with strategy.scope():
+        model = dtpu.Model(dtpu.models.mnist_cnn())
+        model.compile(
+            optimizer=dtpu.optim.SGD(0.001),
+            loss="sparse_categorical_crossentropy",
+            metrics=["accuracy"],
+        )
+    model.build((28, 28, 1))
+    n = max(global_batch * 4, 256)
+    x, y = dtpu.data.synthetic_images(n, (28, 28), 10, 0)
+    x = x[..., None].astype(np.float32) / 255.0
+    y = y.astype(np.int32)
+
+    def one_fit():
+        t0 = time.perf_counter()
+        model.fit(x, y, batch_size=global_batch, epochs=1,
+                  steps_per_epoch=steps, verbose=0, shuffle=False)
+        return steps / (time.perf_counter() - t0)
+
+    one_fit()  # compile + warm; excluded from both sides
+    bare, inst = [], []
+    try:
+        for _ in range(max(1, windows)):
+            obs.set_enabled(False)
+            bare.append(one_fit())
+            obs.set_enabled(True)
+            inst.append(one_fit())
+    finally:
+        obs.set_enabled(True)
+    bare_sps = float(np.median(bare))
+    inst_sps = float(np.median(inst))
+    return {
+        "bare_steps_per_sec": round(bare_sps, 3),
+        "instrumented_steps_per_sec": round(inst_sps, 3),
+        "window_bare": [round(r, 3) for r in bare],
+        "window_instrumented": [round(r, 3) for r in inst],
+        "overhead_pct": round((bare_sps - inst_sps) / bare_sps * 100.0, 3),
+        "steps_per_window": steps,
+        "windows": len(bare),
+    }
+
+
+def bench_obs(global_batch=256, steps=40, windows=5, gang_steps=12,
+              slow_seconds=0.25, threshold=1.5):
+    """Observability runtime cost + straggler attribution (``python
+    bench.py obs``, artifact BENCH_obs.json; docs/OBSERVABILITY.md):
+
+    (a) the overhead gate — mnist_cnn fit through the REAL instrumented
+    hot path (spans, registry, flight records, snapshot windows) vs the
+    identical loop with obs disabled, interleaved windows, ASSERTED
+    <= 3% steps/s; and (b) the attribution gate — a supervised 2-worker
+    gang with a ``slow_steps`` fault on rank 1, whose end-of-run skew
+    aggregation must emit a ``straggler`` event naming rank 1 (keyed on
+    host SELF time: collectives equalize wall across a synchronous gang,
+    so the victim's wait shows in its dispatch bucket while the
+    straggler's slowdown shows in its self time)."""
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    overhead = _obs_overhead(global_batch=global_batch, steps=steps,
+                             windows=windows)
+    tmp = Path(tempfile.mkdtemp(prefix="dtpu_bench_obs_"))
+    try:
+        result, events = _obs_gang(tmp, steps=gang_steps,
+                                   slow_seconds=slow_seconds,
+                                   threshold=threshold)
+        stragglers = [e for e in events if e["event"] == "straggler"]
+        skews = [e for e in events if e["event"] == "rank_skew"]
+        dumps = [e for e in events if e["event"] == "flight_dump"]
+        straggler_row = stragglers[-1] if stragglers else None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    ok_overhead = overhead["overhead_pct"] <= 3.0
+    ok_straggler = bool(
+        result.ok and straggler_row is not None
+        and straggler_row.get("rank") == 1
+    )
+    return {
+        "metric": "obs_instrumentation_overhead_pct",
+        "value": overhead["overhead_pct"],
+        "unit": "%",
+        "ok": bool(ok_overhead and ok_straggler),
+        "overhead": overhead,
+        "overhead_gate_pct": 3.0,
+        "straggler": {
+            "ok": ok_straggler,
+            "injected_rank": 1,
+            "detected_rank": (straggler_row or {}).get("rank"),
+            "skew": (straggler_row or {}).get("skew"),
+            "threshold": threshold,
+            "slow_seconds": slow_seconds,
+            "row": straggler_row,
+            "rank_skew": skews[-1] if skews else None,
+            "flight_dumps": len(dumps),
+        },
+        "note": "overhead pair: interleaved bare/instrumented fit windows "
+                "on the mnist_cnn hot path (median of "
+                f"{overhead['windows']}; 1-core box — dispatch jitter per "
+                "docs/PERF.md). straggler row: supervised XLA:CPU "
+                "2-worker DP gang, rank 1 degraded by slow_steps "
+                f"({slow_seconds}s/step); skew computed on per-step host "
+                "self time from per-step metrics_snapshot flushes over "
+                "DTPU_EVENT_LOG.",
+    }
+
+
 # ------------------------------------------------------------ long context --
 def bench_longctx(configs=((2, 4096, False), (2, 4096, True),
                            (1, 8192, True), (1, 16384, True),
@@ -2603,7 +2795,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
     known = {"mnist", "multistep", "overlap", "input", "convergence",
              "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
              "precision", "compile_cache", "serve", "elastic", "quant",
-             "fused_update", "autoshard", "fleet", "rl", "recovery"}
+             "fused_update", "autoshard", "fleet", "rl", "recovery", "obs"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -2669,6 +2861,11 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # supervised-gang protocol (BENCH_recovery.json;
         # docs/RESILIENCE.md "Recovery tiers").
         extra.append(bench_recovery())
+    if "obs" in modes:
+        # Opt-in: instrumented-vs-bare fit overhead (<= 3% asserted) +
+        # supervised-gang straggler attribution (BENCH_obs.json;
+        # docs/OBSERVABILITY.md).
+        extra.append(bench_obs())
     if "quant" in modes:
         # Opt-in: int8 weight-only serving bytes + decode fidelity + FSDP
         # gather accounting (BENCH_quant.json; docs/PERF.md "Quantization

@@ -26,6 +26,7 @@ Quickstart (the reference's local->distributed 6-line-diff contract):
 """
 
 from . import cluster, data, models, nn, ops, optim, parallel, precision, utils
+from . import obs  # jax-free at import; spans resolve jax lazily
 from .precision import Policy
 from .checkpoint import Checkpointer, ShardedCheckpointer, export_hdf5, import_hdf5
 from .training import callbacks
@@ -103,6 +104,7 @@ __all__ = [
     "cluster",
     "utils",
     "callbacks",
+    "obs",
     "resilience",
     "serving",
     "fleet",  # lazy: see __getattr__

@@ -530,4 +530,17 @@ class ServingFleet:
                 "target": self.autoscaler.target,
                 "events": list(self.autoscaler.events),
             }
-        self.last_run_telemetry = tel
+        # Publish into the unified metrics registry: the fleet's run view
+        # is a stored report (same derived-view contract as fit/engine),
+        # with the SLO-facing aggregates doubled as counters/gauges for
+        # the Prometheus/JSONL exporters (docs/OBSERVABILITY.md).
+        from ..obs import registry as obs_registry
+
+        reg = obs_registry.default_registry()
+        reg.counter("fleet/requests_finished", tel["requests_finished"])
+        reg.counter("fleet/generated_tokens", tel["generated_tokens"])
+        reg.counter("fleet/preemptions", tel["preemptions"])
+        reg.gauge("fleet/tokens_per_sec", tel["tokens_per_sec"])
+        reg.gauge("fleet/queue_depth_peak", queue_peak)
+        reg.gauge("fleet/decode_replicas", len(self.decode_pool))
+        self.last_run_telemetry = reg.set_report("fleet.run", tel)

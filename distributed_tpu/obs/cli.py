@@ -1,0 +1,208 @@
+"""``dtpu-events``: summarize an event log + flight dumps into a postmortem.
+
+    dtpu-events run.events.jsonl
+    dtpu-events run.events.jsonl --flight /tmp/flight-rank1-pid33.jsonl
+    dtpu-events run.events.jsonl --json
+
+Reads a supervised run's JSONL event log (``utils.events``) and renders a
+human postmortem: the attempt timeline, injected faults, per-recovery
+MTTR rows, cross-rank skew / straggler attribution (``obs.aggregate``),
+and the tail of every flight-recorder dump the run referenced
+(``flight_dump`` events; ``--flight`` adds files by hand) — the seconds
+before each death, not just the lifecycle facts. ``--json`` emits the
+same summary as one machine-readable object.
+
+jax-free: runs on any controller box against a copied log file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from ..utils.events import read_events
+from . import aggregate
+from .flight import read_dump
+
+
+def _fmt_ts(ts) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(ts)))
+    except (TypeError, ValueError):
+        return "?"
+
+
+def summarize(events: List[dict], flight_paths=(),
+              straggler_threshold: float = aggregate.DEFAULT_THRESHOLD
+              ) -> dict:
+    """The postmortem as data; ``render`` turns it into text."""
+    attempts = [e for e in events if e["event"] == "attempt_start"]
+    ends = [e for e in events if e["event"] == "attempt_end"]
+    faults = [e for e in events if e["event"] == "fault_injected"]
+    recoveries = [e for e in events if e["event"] == "recovery"]
+    resizes = [e for e in events if e["event"] == "gang_resize"]
+    terminal = next(
+        (e for e in reversed(events)
+         if e["event"] in ("run_complete", "budget_exhausted",
+                           "preemption_cap_exhausted")),
+        None,
+    )
+    dump_paths: List[str] = [
+        e["path"] for e in events
+        if e["event"] == "flight_dump" and e.get("path")
+    ]
+    for p in flight_paths:
+        if str(p) not in dump_paths:
+            dump_paths.append(str(p))
+    dumps = []
+    for p in dump_paths:
+        records = read_dump(p)
+        header = next(
+            (r for r in records if r.get("kind") == "flight_header"), None
+        )
+        dumps.append({
+            "path": str(p),
+            "readable": bool(records),
+            "reason": (header or {}).get("reason"),
+            "rank": (header or {}).get("rank"),
+            "records": [r for r in records
+                        if r.get("kind") != "flight_header"],
+        })
+    return {
+        "events": len(events),
+        "attempts": [
+            {
+                "attempt": a.get("attempt"),
+                "world_size": a.get("world_size"),
+                "started": a.get("ts"),
+                "ok": next(
+                    (e.get("ok") for e in ends
+                     if e.get("attempt") == a.get("attempt")), None
+                ),
+                "failed_ranks": next(
+                    (e.get("failed_ranks") for e in ends
+                     if e.get("attempt") == a.get("attempt")), None
+                ),
+            }
+            for a in attempts
+        ],
+        "terminal": terminal,
+        "faults": faults,
+        "resizes": resizes,
+        "recoveries": recoveries,
+        "rank_skew": aggregate.skew_report(events),
+        "straggler": aggregate.straggler(events, straggler_threshold),
+        "straggler_events": [e for e in events if e["event"] == "straggler"],
+        "flight_dumps": dumps,
+    }
+
+
+def render(summary: dict, *, tail: int = 10) -> str:
+    lines = [f"postmortem: {summary['events']} events"]
+    for a in summary["attempts"]:
+        status = ("ok" if a["ok"] else
+                  "FAILED" if a["ok"] is not None else "no end record")
+        extra = (f" failed_ranks={a['failed_ranks']}"
+                 if a.get("failed_ranks") else "")
+        lines.append(
+            f"  attempt {a['attempt']} [{_fmt_ts(a['started'])}] "
+            f"world={a['world_size']}: {status}{extra}"
+        )
+    term = summary["terminal"]
+    if term is not None:
+        lines.append(f"  terminal: {term['event']}")
+    for f in summary["faults"]:
+        where = f" replica={f['replica']}" if f.get("replica") else ""
+        lines.append(
+            f"  fault injected: {f.get('mode')} at step {f.get('step')}"
+            f"{where} [{_fmt_ts(f.get('ts'))}]"
+        )
+    for rs in summary["resizes"]:
+        lines.append(
+            f"  gang resize {rs.get('from_world')} -> {rs.get('to_world')} "
+            f"({rs.get('reason')}, {rs.get('trigger')})"
+        )
+    for r in summary["recoveries"]:
+        lines.append(
+            f"  recovery (attempt {r.get('failed_attempt')} -> "
+            f"{r.get('recovered_attempt')}): detect={r.get('detect_s')}s "
+            f"gang_reform={r.get('gang_reform_s')}s "
+            f"restore={r.get('restore_s')}s[{r.get('restore_tier')}] "
+            f"recompile={r.get('recompile_s')}s"
+        )
+        for p in r.get("flight_dumps") or ():
+            lines.append(f"    flight dump: {p}")
+    skew = summary["rank_skew"]
+    if skew is not None:
+        lines.append(
+            f"  rank skew: gang median {skew['gang_median_step_s']}s/step, "
+            f"max skew {skew['max_skew']}x (rank {skew['slowest_rank']})"
+        )
+        for row in skew["ranks"]:
+            lines.append(
+                f"    rank {row['rank']}: median {row['median_step_s']}s "
+                f"(x{row['skew']}, {row['samples']} samples)"
+            )
+    strag = summary["straggler"] or next(
+        iter(summary["straggler_events"]), None
+    )
+    if strag is not None:
+        lines.append(
+            f"  STRAGGLER: rank {strag.get('rank')} at "
+            f"{strag.get('skew')}x the gang median "
+            f"(threshold {strag.get('threshold')})"
+        )
+    for d in summary["flight_dumps"]:
+        if not d["readable"]:
+            lines.append(f"  flight dump {d['path']}: unreadable/empty")
+            continue
+        lines.append(
+            f"  flight dump {d['path']} (rank {d['rank']}, "
+            f"reason={d['reason']!r}): last {min(tail, len(d['records']))} "
+            f"of {len(d['records'])} records"
+        )
+        for rec in d["records"][-tail:]:
+            body = {k: v for k, v in rec.items() if k not in ("ts", "kind")}
+            lines.append(
+                f"    [{_fmt_ts(rec.get('ts'))}] {rec.get('kind')} "
+                + " ".join(f"{k}={v}" for k, v in body.items())
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="dtpu-events", description=__doc__)
+    ap.add_argument("event_log", type=str,
+                    help="JSONL event log (the supervisor's DTPU_EVENT_LOG)")
+    ap.add_argument("--flight", action="append", default=[],
+                    help="extra flight-dump file(s) to include (dumps "
+                         "referenced by flight_dump events are found "
+                         "automatically)")
+    ap.add_argument("--tail", type=int, default=10,
+                    help="flight records to show per dump (default 10)")
+    ap.add_argument("--straggler-threshold", type=float,
+                    default=aggregate.DEFAULT_THRESHOLD)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead of "
+                         "the human rendering")
+    args = ap.parse_args(argv)
+    if not Path(args.event_log).exists():
+        print(f"dtpu-events: no such event log: {args.event_log}",
+              file=sys.stderr)
+        return 2
+    events = read_events(args.event_log)
+    summary = summarize(events, flight_paths=args.flight,
+                        straggler_threshold=args.straggler_threshold)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary, tail=args.tail))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,110 @@
+"""Host-side span tracer: nested named regions, one code path for all
+attribution.
+
+``with obs.span("prefill"):`` times a block and
+
+- accrues the elapsed seconds into the metrics registry as a
+  ``span_seconds/<path>`` histogram (``<path>`` is the slash-joined
+  nesting, e.g. ``decode/sample``) plus a ``span_calls/<path>`` counter,
+- forwards the block to ``jax.profiler.TraceAnnotation`` so the SAME
+  name shows up on XProf/TensorBoard device timelines, and
+- optionally attributes into a live ``StepTimer`` (``span(name,
+  timer=t)`` calls ``t.attribute(name, seconds)``), which is how the
+  train/serve/checkpoint stall categories flow through one code path
+  instead of hand-rolled ``perf_counter`` pairs.
+
+The jax import is lazy (and optional): a jax-free controller process can
+use spans — they just skip the trace annotation. When the registry is
+disabled (``obs.set_enabled(False)`` / ``DTPU_OBS=0``) a span degrades to
+a plain timed block: the timer attribution still happens (legacy
+telemetry must not change when observability is off), the registry and
+annotation work is skipped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+from . import registry as registry_mod
+
+_tls = threading.local()
+
+_trace_annotation = None  # resolved lazily: jax.profiler.TraceAnnotation
+
+
+def _annotation(name: str):
+    global _trace_annotation
+    if _trace_annotation is None:
+        try:
+            import jax
+
+            _trace_annotation = jax.profiler.TraceAnnotation
+        except Exception:  # jax-free controller: spans still time/attribute
+            _trace_annotation = contextlib.nullcontext
+    try:
+        return _trace_annotation(name)
+    except TypeError:  # nullcontext() takes no useful arg on some versions
+        return contextlib.nullcontext()
+
+
+def span_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def current_span() -> Optional[str]:
+    """Slash-joined path of the innermost open span on this thread."""
+    stack = span_stack()
+    return "/".join(stack) if stack else None
+
+
+class Span:
+    """Yielded handle: ``seconds`` is filled when the block exits, so the
+    caller can reuse the measured wall time (the fit loop's flight-record
+    rows) without timing the block twice."""
+
+    __slots__ = ("name", "path", "seconds")
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        self.seconds = 0.0
+
+
+@contextlib.contextmanager
+def span(name: str, *, timer=None, registry=None):
+    """Time a named, nestable region. See module docstring.
+
+    ``timer``: a ``utils.profiler.StepTimer`` to attribute the elapsed
+    seconds to (category = ``name``, NOT the nested path — stall buckets
+    stay flat, matching the pre-span contract). ``registry``: override
+    the target registry (default: the process-global one).
+    """
+    stack = span_stack()
+    stack.append(name)
+    path = "/".join(stack)
+    handle = Span(name, path)
+    on = registry_mod.enabled()
+    ctx = _annotation(name) if on else contextlib.nullcontext()
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield handle
+    finally:
+        dt = time.perf_counter() - t0
+        handle.seconds = dt
+        stack.pop()
+        if timer is not None:
+            timer.attribute(name, dt)
+        if on:
+            reg = registry or registry_mod.default_registry()
+            reg.observe(f"span_seconds/{path}", dt)
+            reg.counter(f"span_calls/{path}")
+
+
+__all__ = ["Span", "current_span", "span", "span_stack"]

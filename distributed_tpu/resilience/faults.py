@@ -14,6 +14,13 @@ worker fail in a chosen mode at a chosen step, once:
   progress (a long in-step sleep), so heartbeats stall below the
   launcher's ``liveness_timeout`` — hung-in-Python rather than
   hung-in-kernel.
+- ``slow_steps``: a PERSISTENT degradation, not a death — from
+  ``at_step`` on, every step sleeps ``slow_seconds``. The worker keeps
+  heartbeating and finishing, just slower than its peers: the straggler
+  the cross-rank skew aggregation (``obs.aggregate``) exists to name,
+  and what ``bench.py obs`` injects to verify the ``straggler`` event
+  fires on a real supervised gang. Fires every step (no once-marker
+  disarm after the first hit); ``fault_injected`` is emitted once.
 - ``corrupt_checkpoint``: clobber the newest checkpoint file, then die —
   exercising restore's fall-back-to-previous-step path.
 - ``replica_kill``: address a NAMED serving-fleet pool member (e.g.
@@ -59,8 +66,9 @@ from ..utils import events as events_lib
 ENV_VAR = "DTPU_FAULT"
 MARKER_ENV_VAR = "DTPU_FAULT_MARKER"
 
-MODES = ("kill", "hang", "slow_heartbeat", "corrupt_checkpoint",
-         "replica_kill", "buddy_kill", "kill_during_refresh")
+MODES = ("kill", "hang", "slow_heartbeat", "slow_steps",
+         "corrupt_checkpoint", "replica_kill", "buddy_kill",
+         "kill_during_refresh")
 
 # kill_during_refresh arming: injectors register here at on_train_begin
 # and the buddy-refresh writer polls fire_refresh_kill() mid-refresh.
@@ -129,6 +137,7 @@ class FaultInjector(Callback):
     def __init__(self, mode: str, *, at_step: int = 5,
                  rank: Optional[int] = 0, once_marker=None,
                  exit_code: int = 17, hang_seconds: float = 10_000.0,
+                 slow_seconds: float = 0.25,
                  directory=None, replica: Optional[str] = None):
         if mode not in MODES:
             raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
@@ -153,15 +162,18 @@ class FaultInjector(Callback):
         self.once_marker = Path(once_marker) if once_marker else None
         self.exit_code = int(exit_code)
         self.hang_seconds = float(hang_seconds)
+        self.slow_seconds = float(slow_seconds)
         self.directory = directory
         self.replica = replica
         self.fired = False
+        self._slow_announced = False  # slow_steps: one fault_injected event
 
     @classmethod
     def from_env(cls) -> Optional["FaultInjector"]:
         """Build from ``DTPU_FAULT`` ("mode" or "mode:key=val,key=val";
         keys: at_step, rank [int or 'all'], exit_code, hang_seconds,
-        directory, replica) and ``DTPU_FAULT_MARKER`` (once-only arming). Returns
+        slow_seconds, directory, replica) and ``DTPU_FAULT_MARKER``
+        (once-only arming). Returns
         None when the variable is unset — scripts can unconditionally
         append ``*filter(None, [FaultInjector.from_env()])``."""
         spec = os.environ.get(ENV_VAR)
@@ -176,7 +188,7 @@ class FaultInjector(Callback):
                 kw[key] = int(val)
             elif key == "rank":
                 kw[key] = None if val == "all" else int(val)
-            elif key == "hang_seconds":
+            elif key in ("hang_seconds", "slow_seconds"):
                 kw[key] = float(val)
             elif key in ("directory", "replica"):
                 kw[key] = val
@@ -247,6 +259,7 @@ class FaultInjector(Callback):
             marker.parent.mkdir(parents=True, exist_ok=True)
             marker.touch()
         events_lib.emit("fault_injected", mode=self.mode, step=int(step))
+        self._flight_dump(step)
         os._exit(self.exit_code)
 
     def should_kill_replica(self, name: str, step: int) -> bool:
@@ -276,6 +289,27 @@ class FaultInjector(Callback):
             return  # fleet-driven (should_kill_replica), not training-driven
         if self.mode == "kill_during_refresh":
             return  # refresh-driven (fire_refresh_kill), not step-driven
+        if self.mode == "slow_steps":
+            # Persistent degradation: every step from at_step on runs
+            # slow_seconds late. Never sets `fired` (a straggler keeps
+            # straggling); a pre-existing once-marker still disarms it.
+            if step < self.at_step:
+                return
+            marker = self._marker_path()
+            if marker is not None and marker.exists():
+                return
+            if self.rank is not None:
+                import jax
+
+                if jax.process_index() != self.rank:
+                    return
+            if not self._slow_announced:
+                self._slow_announced = True
+                events_lib.emit("fault_injected", mode=self.mode,
+                                step=int(step),
+                                slow_seconds=self.slow_seconds)
+            time.sleep(self.slow_seconds)
+            return
         if step < self.at_step or not self._armed():
             return
         self.fired = True
@@ -285,6 +319,7 @@ class FaultInjector(Callback):
             marker.touch()
         events_lib.emit("fault_injected", mode=self.mode, step=int(step))
         if self.mode in ("kill", "buddy_kill"):
+            self._flight_dump(step)
             os._exit(self.exit_code)
         elif self.mode == "hang":
             # Frozen, not dead: exit-code monitoring sees nothing; only the
@@ -296,4 +331,15 @@ class FaultInjector(Callback):
             time.sleep(self.hang_seconds)
         elif self.mode == "corrupt_checkpoint":
             corrupt_latest_checkpoint(self.directory)
+            self._flight_dump(step)
             os._exit(self.exit_code)
+
+    def _flight_dump(self, step):
+        """Injected deaths leave the black box behind: dump the flight
+        ring (the last N step records) before ``os._exit``, which skips
+        every Python-level cleanup — so the dump IS the only record of
+        the final seconds. Never blocks the kill (dump() swallows
+        errors; no-op without a configured dump location)."""
+        from ..obs import flight as obs_flight
+
+        obs_flight.dump(reason=f"fault:{self.mode}", step=int(step))

@@ -154,6 +154,12 @@ class PreemptionHandler(Callback):
                             exit_code=self.exit_code)
         if self.exit_code is not None:
             self._uninstall()
+            # Black-box dump before death: the last N step records land
+            # next to the event log (docs/OBSERVABILITY.md "Flight
+            # recorder"); no-op unless a dump location is configured.
+            from ..obs import flight as obs_flight
+
+            obs_flight.dump(reason="preempted", step=int(step))
             # sys.exit, not os._exit: SystemExit unwinds the stack so log
             # handles flush and the launcher's result file (if any) stays
             # consistent; fit() is abandoned by design.

@@ -74,7 +74,12 @@ def recovery_rows(events: Sequence[dict]) -> List[dict]:
     no restore markers, and the row then only attributes what it can.
     The supervisor emits each row as a ``recovery`` event at run end, so
     BENCH_recovery.json and user telemetry attribute recovery time
-    honestly instead of reporting one opaque restart latency."""
+    honestly instead of reporting one opaque restart latency.
+
+    ``flight_dumps`` lists the flight-recorder dump files the FAILED
+    attempt left behind (``flight_dump`` events, emitted by the
+    fault/preemption/exception death paths — obs.flight): the postmortem
+    row names the black boxes holding the seconds before that death."""
 
     def _rank0(e):
         return e.get("rank") in (None, 0)
@@ -106,9 +111,16 @@ def recovery_rows(events: Sequence[dict]) -> List[dict]:
         def span(a, b):
             return round(b["ts"] - a["ts"], 4) if (a and b) else None
 
+        dumps = sorted({
+            e["path"] for e in events
+            if e["event"] == "flight_dump" and e.get("path")
+            and (e.get("attempt") == attempt
+                 or (e.get("attempt") is None and e["ts"] <= t_fail))
+        })
         rows.append({
             "failed_attempt": attempt,
             "recovered_attempt": nxt,
+            "flight_dumps": dumps,
             "detect_s": span(fault, ends[attempt]),
             "gang_reform_s": span(ends[attempt], rb),
             "restore_s": span(rb, re_),
@@ -223,6 +235,7 @@ class Supervisor:
         event_log: Optional[events_lib.EventLog] = None,
         env_extra: Optional[Dict[str, str]] = None,
         liveness_timeout: Optional[float] = None,
+        straggler_threshold: Optional[float] = None,
         sleep=time.sleep,
     ):
         self.argv = list(argv)
@@ -242,6 +255,13 @@ class Supervisor:
         self.event_log = event_log
         self.env_extra = dict(env_extra or {})
         self.liveness_timeout = liveness_timeout
+        # Cross-rank straggler attribution (docs/OBSERVABILITY.md): a
+        # worker whose median step time exceeds the gang median by this
+        # factor gets named in a `straggler` event at run end (the
+        # workers' metrics_snapshot flushes ride the event log this
+        # supervisor already shares with them). None = the
+        # obs.aggregate default.
+        self.straggler_threshold = straggler_threshold
         self._sleep = sleep
         # SSH-style launchers derive the gang from a host list; elastic
         # resizes then operate on this working copy (lost ranks' hosts
@@ -531,20 +551,61 @@ class Supervisor:
 
     def _emit_recoveries(self):
         """MTTR telemetry: one `recovery` event per restart boundary with
-        the detect/gang-reform/restore/recompile split and the restore
-        tier used — computed from the run's own event stream right before
-        the terminal event, so post-mortems and bench.py recovery read
-        rows, not raw timestamps."""
+        the detect/gang-reform/restore/recompile split, the restore
+        tier used, and the failed attempt's flight-dump paths — computed
+        from the run's own event stream right before the terminal event,
+        so post-mortems and bench.py recovery read rows, not raw
+        timestamps. Also the cross-rank skew boundary: a `rank_skew`
+        summary over the workers' metrics_snapshot flushes, plus a
+        `straggler` event naming the slowest rank when its median step
+        time exceeds the gang median by `straggler_threshold` (verified
+        end-to-end by bench.py obs)."""
         if self.event_log is None:
             return
         try:
-            for row in recovery_rows(self.event_log.read()):
+            events = self.event_log.read()
+            for row in recovery_rows(events):
                 self._emit("recovery", **row)
+            self._emit_skew(events)
         except OSError:
             pass
 
+    def _emit_skew(self, events):
+        from ..obs import aggregate  # jax-free (plain event math)
+
+        report = aggregate.skew_report(events)
+        if report is None:
+            return
+        self._emit("rank_skew", **report)
+        threshold = (self.straggler_threshold
+                     if self.straggler_threshold is not None
+                     else aggregate.DEFAULT_THRESHOLD)
+        row = aggregate.straggler(events, threshold)
+        if row is not None:
+            self._emit("straggler", **row)
+            dlog.warning(
+                f"Supervisor: straggler rank {row['rank']} at "
+                f"{row['skew']}x the gang median step time "
+                f"({row['median_step_s']}s vs "
+                f"{row['gang_median_step_s']}s, threshold {threshold})"
+            )
+
     def _result(self, ok, attempts, restarts_used, preemptions, results,
                 resizes=0, world_size=None):
+        # Controller-side registry view (docs/OBSERVABILITY.md): the run's
+        # restart accounting as counters/gauges next to the rank_skew /
+        # straggler events it emitted — a scraper on the supervisor
+        # process sees gang health without parsing the event log.
+        from ..obs import registry as obs_registry  # jax-free
+
+        reg = obs_registry.default_registry()
+        reg.counter("supervisor/attempts", attempts)
+        reg.counter("supervisor/restarts", restarts_used)
+        reg.counter("supervisor/preemptions", preemptions)
+        reg.counter("supervisor/resizes", resizes)
+        reg.gauge("supervisor/ok", 1.0 if ok else 0.0)
+        if world_size is not None:
+            reg.gauge("supervisor/world_size", world_size)
         return SupervisedResult(
             ok=ok,
             attempts=attempts,

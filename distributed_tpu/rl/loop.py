@@ -338,6 +338,21 @@ class PostTrainer:
             "weights_version": version,
         }
         self.history.append(row)
+        # Registry view of the closed loop: the latest iteration row is a
+        # stored report, with the loop couplings (rollout rate, train
+        # rate, sync latency, reward) as gauges/counters so a scraper can
+        # watch post-training health without touching .history.
+        from ..obs import registry as obs_registry
+
+        reg = obs_registry.default_registry()
+        reg.counter("rl/iterations")
+        reg.counter("rl/rollouts", len(rollouts))
+        reg.gauge("rl/reward_mean", row["reward_mean"])
+        reg.gauge("rl/kl", measured_kl if measured_kl is not None else 0.0)
+        reg.gauge("rl/weight_sync_s", row["weight_sync_s"])
+        reg.gauge("rl/rollout_tokens_per_sec",
+                  row["rollout_tokens_per_sec"])
+        reg.set_report("rl.iteration", row)
         return row
 
     def train(self, prompts, *, iterations: int = 4, num_samples: int = 4,

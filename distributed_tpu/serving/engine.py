@@ -55,6 +55,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import registry as obs_registry
+from ..obs import spans as obs_spans
 from ..training.model import Model, _cast_for_compute
 from ..utils.profiler import StepTimer
 from .kv_cache import PagedKVCache
@@ -387,6 +389,7 @@ class Engine:
                     f"max_len {self.max_len}"
                 )
         timer = StepTimer(warmup=0)
+        obs_reg = obs_registry.default_registry()
         sched = Scheduler(self.max_slots)
         self._sched = sched
         t0 = time.perf_counter()
@@ -450,22 +453,25 @@ class Engine:
                 cb = self._bucket(c, start)
                 buf = np.zeros((1, cb), np.int32)
                 buf[0, :c] = seq.tokens[start:start + c]
-                tp = time.perf_counter()
-                tok, logp, self.kv.caches = self._prefill_fn(
-                    self._params, self._state, self.kv.caches, buf,
-                    self.kv.block_tables[seq.slot],
-                    np.int32(start),
-                    np.int32(seq.context_len - 1 - start
-                             if idx == len(chunks) - 1 else c - 1),
-                    _token_key(seq.sample_seed, seq.num_generated),
-                )
-                prefill_dispatches += 1
-                job[2] = idx + 1
-                if job[2] == len(chunks):
-                    # Final chunk: the sampled continuation is real.
-                    first, first_lp = jax.device_get((tok, logp))
-                    first = int(first)
-                    timer.attribute("prefill", time.perf_counter() - tp)
+                # prefill attribution flows through the span tracer (same
+                # name lands on XProf timelines and in the registry).
+                with obs_spans.span("prefill", timer=timer):
+                    tok, logp, self.kv.caches = self._prefill_fn(
+                        self._params, self._state, self.kv.caches, buf,
+                        self.kv.block_tables[seq.slot],
+                        np.int32(start),
+                        np.int32(seq.context_len - 1 - start
+                                 if idx == len(chunks) - 1 else c - 1),
+                        _token_key(seq.sample_seed, seq.num_generated),
+                    )
+                    prefill_dispatches += 1
+                    job[2] = idx + 1
+                    final_chunk = job[2] == len(chunks)
+                    if final_chunk:
+                        # Final chunk: the sampled continuation is real.
+                        first, first_lp = jax.device_get((tok, logp))
+                        first = int(first)
+                if final_chunk:
                     prefill_jobs.pop(0)
                     self.kv.positions[seq.slot] = seq.context_len
                     seq.tokens.append(first)
@@ -478,8 +484,6 @@ class Engine:
                         seq.first_token_at = elapsed()
                     if seq.finished or first == self.eos_id:
                         finish(seq)
-                else:
-                    timer.attribute("prefill", time.perf_counter() - tp)
             # -- decode: every running slot whose prefill is done ---------
             mid_prefill = {
                 id(j[0]) for j in prefill_jobs if j[0].slot is not None
@@ -531,18 +535,27 @@ class Engine:
             positions = np.where(ready_mask, self.kv.positions, 0).astype(
                 np.int32
             )
-            td = time.perf_counter()
-            sampled, logps, self.kv.caches = self._decode_fn(
-                self._params, self._state, self.kv.caches, tokens, tables,
-                positions, keys,
-            )
-            sampled, logps = jax.device_get((sampled, logps))
-            sampled = np.asarray(sampled)
-            timer.attribute("decode", time.perf_counter() - td)
+            with obs_spans.span("decode", timer=timer) as sp_dec:
+                sampled, logps, self.kv.caches = self._decode_fn(
+                    self._params, self._state, self.kv.caches, tokens,
+                    tables, positions, keys,
+                )
+                sampled, logps = jax.device_get((sampled, logps))
+                sampled = np.asarray(sampled)
             decode_steps += 1
-            util_samples.append(self.kv.utilization())
+            util = self.kv.utilization()
+            util_samples.append(util)
             queue_samples.append(len(sched.waiting))
             free_blocks_min = min(free_blocks_min, self.kv.allocator.num_free)
+            # Live registry signals (the fleet router/autoscaler read the
+            # properties mid-run; exporters read these):
+            obs_reg.gauge("engine/kv_utilization", float(util))
+            obs_reg.gauge("engine/queue_depth", len(sched.waiting))
+            obs_reg.ring_append("engine/step_seconds", {
+                "step": int(decode_steps),
+                "seconds": round(sp_dec.seconds, 6),
+                "running": len(ready),
+            })
             for seq in ready:
                 tok = int(sampled[seq.slot])
                 self.kv.positions[seq.slot] = seq.context_len
@@ -625,7 +638,12 @@ class Engine:
         report["decode_steps"] = decode_steps
         report["prefill_dispatches"] = prefill_dispatches
         report["preemptions"] = preemptions
-        self.last_run_telemetry = report
+        obs_reg.counter("engine/generated_tokens", report["generated_tokens"])
+        obs_reg.counter("engine/requests", len(reqs))
+        obs_reg.counter("engine/preemptions", preemptions)
+        obs_reg.gauge("engine/tokens_per_sec", report["tokens_per_sec"])
+        # Legacy dict = registry view, key-for-key (obs parity test).
+        self.last_run_telemetry = obs_reg.set_report("engine.run", report)
         return [results[r.request_id] for r in reqs]
 
 

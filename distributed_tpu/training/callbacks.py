@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from ..checkpoint import Checkpointer, ShardedCheckpointer
+from ..obs import spans as obs_spans
 from ..utils import events as devents
 from ..utils import logging as dlog
 
@@ -135,15 +136,12 @@ class ModelCheckpoint(Callback):
 
     def _timed(self, model, fn):
         """Run a (possibly blocking) checkpoint operation, attributing the
-        blocked wall time to the active fit's checkpoint_wait bucket."""
-        t0 = time.perf_counter()
-        try:
+        blocked wall time to the active fit's checkpoint_wait bucket —
+        through the obs span tracer, so checkpoint attribution shares the
+        train/serve code path (registry counter + XProf annotation)."""
+        timer = getattr(model, "_stall_timer", None)
+        with obs_spans.span("checkpoint_wait", timer=timer):
             return fn()
-        finally:
-            timer = getattr(model, "_stall_timer", None)
-            if timer is not None:
-                timer.attribute("checkpoint_wait",
-                                time.perf_counter() - t0)
 
     def _select_tier(self):
         """(tier, step) for this recovery, agreed gang-wide: the chief's

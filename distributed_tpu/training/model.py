@@ -44,6 +44,10 @@ from ..ops import losses as losses_lib
 from ..ops import metrics as metrics_lib
 from ..parallel.strategy import SingleDevice, Strategy, current_strategy
 from ..launch.core import heartbeat as _gang_heartbeat
+from ..obs import flight as obs_flight
+from ..obs import registry as obs_registry
+from ..obs import spans as obs_spans
+from ..utils import events as events_lib
 from ..utils import logging as dlog
 from ..utils.tree import tree_size
 from .progress import ProgressLine
@@ -1246,10 +1250,42 @@ class Model:
         # model._stall_timer). Summarized into last_fit_telemetry at exit.
         timer = StepTimer(warmup=0)
         self._stall_timer = timer
+        # Observability runtime (docs/OBSERVABILITY.md): per-dispatch
+        # flight records + step-seconds ring, and a periodic cross-rank
+        # metrics_snapshot flush over the supervisor's event-log
+        # transport (no-op unsupervised). All gated on obs.enabled().
+        obs_reg = obs_registry.default_registry()
+        obs_rec = obs_flight.default_recorder()
+        obs_flush_every = max(
+            1, int(os.environ.get("DTPU_OBS_FLUSH_EVERY", "5") or 5)
+        )
+        obs_window: list = []  # per-STEP wall seconds since last flush
+
+        def _flush_obs_window(force: bool = False):
+            # step_seconds: per-step wall. self_seconds: wall MINUS the
+            # dispatch/input waits — the rank's own host time. Collectives
+            # equalize wall across a synchronous gang (victims wait in
+            # dispatch while the straggler burns host time), so cross-rank
+            # straggler attribution keys on self time (obs.aggregate).
+            if not obs_window or (
+                not force and len(obs_window) < obs_flush_every
+            ):
+                return
+            if obs_registry.enabled() and events_lib.default_log() is not None:
+                events_lib.emit(
+                    "metrics_snapshot",
+                    rank=int(jax.process_index()),
+                    world=int(jax.process_count()),
+                    step=int(self.step),
+                    step_seconds=[round(w, 6) for w, _ in obs_window[-64:]],
+                    self_seconds=[round(s, 6) for _, s in obs_window[-64:]],
+                )
+            obs_window.clear()
         history = History()
         is_chief = jax.process_index() == 0
         self.stop_training = False
         self._resumed_step = None
+        fit_steps_done = 0  # this fit's optimizer steps (steps/s gauge)
         for cb in callbacks:
             cb.on_train_begin(self)
         if y is not None:
@@ -1394,33 +1430,37 @@ class Model:
             # depth 0 stages inline — byte-identical, just synchronous.
             staged = DevicePrefetcher(stage, sizes, depth=prefetch)
             done = 0
+            last_iter_t = time.perf_counter()
             try:
                 for k in sizes:
-                    tw = time.perf_counter()
-                    _, batch = staged.get()
-                    timer.attribute("input_wait", time.perf_counter() - tw)
-                    td = time.perf_counter()
-                    if multi_k == 1:
-                        rng = self._step_rng()
-                        (self.params, self.state, self.opt_state, loss,
-                         mvals) = step_fn(
-                            self.params, self.state, self.opt_state,
-                            batch["x"], batch["y"], rng,
-                        )
-                        loss_log = loss
-                    else:
-                        (self.params, self.state, self.opt_state, loss,
-                         mvals) = multi_fn(
-                            self.params, self.state, self.opt_state,
-                            batch["x"], batch["y"], base_rng,
-                            np.int32(self.step),
-                        )
-                        # Callbacks see the dispatch's per-step mean, as a
-                        # device scalar (reading it still costs a sync).
-                        loss_log = loss / k
-                    timer.attribute("dispatch", time.perf_counter() - td)
+                    # input_wait / dispatch flow through obs spans (ONE
+                    # attribution code path: StepTimer bucket + registry
+                    # stall counter + span histogram + XProf annotation).
+                    with obs_spans.span("input_wait", timer=timer) as sp_in:
+                        _, batch = staged.get()
+                    with obs_spans.span("dispatch", timer=timer) as sp_disp:
+                        if multi_k == 1:
+                            rng = self._step_rng()
+                            (self.params, self.state, self.opt_state, loss,
+                             mvals) = step_fn(
+                                self.params, self.state, self.opt_state,
+                                batch["x"], batch["y"], rng,
+                            )
+                            loss_log = loss
+                        else:
+                            (self.params, self.state, self.opt_state, loss,
+                             mvals) = multi_fn(
+                                self.params, self.state, self.opt_state,
+                                batch["x"], batch["y"], base_rng,
+                                np.int32(self.step),
+                            )
+                            # Callbacks see the dispatch's per-step mean,
+                            # as a device scalar (reading it still costs a
+                            # sync).
+                            loss_log = loss / k
                     self.step += k
                     done += k
+                    fit_steps_done += k
                     # Liveness beat for gang launchers (throttled no-op
                     # outside a gang): a worker blocked at a collective
                     # stops beating and the launcher's liveness_timeout
@@ -1435,12 +1475,45 @@ class Model:
                         cb.on_batch_end(self, self.step, {"loss": loss_log})
                     if bar is not None:
                         bar.update(done)
+                    # Per-iteration wall (input + dispatch + callbacks —
+                    # everything between dispatch boundaries, which is
+                    # what a cross-rank straggler comparison needs): one
+                    # flight record + step-seconds ring entry, host-side
+                    # only — no device value is fetched here.
+                    now_t = time.perf_counter()
+                    iter_wall = now_t - last_iter_t
+                    last_iter_t = now_t
+                    self_s = max(
+                        iter_wall - sp_in.seconds - sp_disp.seconds, 0.0
+                    )
+                    obs_reg.ring_append("fit/step_seconds", {
+                        "step": int(self.step), "k": int(k),
+                        "seconds": round(iter_wall, 6),
+                        "self_seconds": round(self_s, 6),
+                    })
+                    obs_rec.record(
+                        "step", step=int(self.step), k=int(k),
+                        seconds=round(iter_wall, 6),
+                        input_wait_s=round(sp_in.seconds, 6),
+                        dispatch_s=round(sp_disp.seconds, 6),
+                        self_s=round(self_s, 6),
+                    )
+                    obs_reg.counter("fit/steps", k)
+                    obs_window.append((iter_wall / k, self_s / k))
+                    _flush_obs_window()
                     if self.stop_training:
                         # Graceful mid-epoch stop (PreemptionHandler's
                         # in-process mode): the partial epoch's metrics are
                         # reported over the steps that actually ran, and the
                         # checkpoint/step cursor resumes exactly here.
                         break
+            except SystemExit:
+                raise  # deliberate exit (preemption) — its own dump ran
+            except BaseException as e:
+                # Unhandled death of the step loop: leave the black box
+                # behind (no-op unless a dump location is configured).
+                obs_flight.dump(reason=f"exception:{type(e).__name__}")
+                raise
             finally:
                 staged.close()
                 if staged.unconsumed_steps and y is None:
@@ -1468,9 +1541,8 @@ class Model:
             # list entries are already on-device K-step sums. This is where
             # async dispatch catches up with real compute — attributed to
             # dispatch time, like the donation waits it back-loads.
-            td = time.perf_counter()
-            losses, fetched = jax.device_get((losses, msums))
-            timer.attribute("dispatch", time.perf_counter() - td)
+            with obs_spans.span("dispatch", timer=timer):
+                losses, fetched = jax.device_get((losses, msums))
             if multi_k == 1:
                 logs = {"loss": float(np.mean(losses))}
             else:
@@ -1500,6 +1572,10 @@ class Model:
                 logs.update({f"val_{k}": v for k, v in val.items()})
             dt = time.perf_counter() - t0
             history.record(epoch, logs)
+            obs_rec.record("epoch_end", epoch=int(epoch),
+                           steps=int(epoch_steps),
+                           seconds=round(dt, 4),
+                           loss=round(float(logs["loss"]), 6))
             for cb in callbacks:
                 cb.on_epoch_end(self, epoch, logs)
                 # Checkpoint writes etc. can be slow; keep beating between
@@ -1567,7 +1643,21 @@ class Model:
         # candidates' rationale (docs/PERF.md "Autotuned sharding").
         if self.last_plan is not None:
             report["plan"] = self.last_plan.summary()
-        self.last_fit_telemetry = report
+        # The legacy dict is a VIEW stored in the metrics registry
+        # (key-for-key identical — pinned by the obs parity test): one
+        # telemetry surface, backward-compatible reader.
+        _flush_obs_window(force=True)
+        obs_reg.gauge("fit/steps_per_sec", round(
+            fit_steps_done / report["total_seconds"], 3))
+        obs_reg.gauge("fit/input_stall_fraction",
+                      report["input_stall_fraction"])
+        obs_reg.gauge("fit/model_state_bytes_per_device",
+                      report["model_state_bytes_per_device"])
+        dm = report["device_memory"]
+        if dm:
+            for key, val in dm.items():
+                obs_reg.gauge(f"fit/device_memory/{key}", val)
+        self.last_fit_telemetry = obs_reg.set_report("model.fit", report)
         self._stall_timer = None
         return history
 

@@ -8,16 +8,25 @@ that died mid-write (every record is flushed AND fsynced before the caller
 continues, and a torn final line is skipped on read, never a parse error).
 
 Transport: the supervisor exports ``DTPU_EVENT_LOG`` to its workers, so
-worker-side emitters (callbacks, restore fallback) land in the same file
-the supervisor writes its attempt records to. Without the env var (and
-without an explicit ``EventLog``), ``emit`` is a no-op — unsupervised runs
-pay nothing.
+worker-side emitters (callbacks, restore fallback, the obs snapshot
+flusher) land in the same file the supervisor writes its attempt records
+to. Without the env var (and without an explicit ``EventLog``), ``emit``
+is a no-op — unsupervised runs pay nothing.
+
+Durability vs cost: each record is ONE ``write()`` on a cached
+O_APPEND handle (kernel-atomic interleaving across concurrent writer
+processes — whole lines only, pinned by tests/test_obs.py), then
+``flush`` + ``fsync``. The handle is reused across emits and reopened
+when the file was rotated or unlinked underneath us (inode mismatch /
+ENOENT), keeping the per-record syscall count at stat+write+fsync
+instead of the old open+write+fsync+close.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Dict, List, Optional
@@ -30,15 +39,56 @@ class EventLog:
 
     def __init__(self, path):
         self.path = Path(path)
+        self._f = None
+        self._ino = None
+        self._lock = threading.Lock()
+
+    def _file(self):
+        """The cached append handle, reopened when the path was rotated
+        away or removed (a log rotator renames the file; new records must
+        land in a fresh file at the configured path, not chase the old
+        inode)."""
+        if self._f is not None:
+            try:
+                if os.stat(self.path).st_ino == self._ino:
+                    return self._f
+            except OSError:
+                pass  # ENOENT: unlinked/renamed — reopen below
+            self._close_handle()
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a")
+        self._ino = os.fstat(self._f.fileno()).st_ino
+        return self._f
+
+    def _close_handle(self):
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+            self._ino = None
 
     def emit(self, kind: str, **fields) -> dict:
         rec = {"ts": time.time(), "event": kind, "pid": os.getpid(), **fields}
-        self.path.parent.mkdir(parents=True, exist_ok=True)
-        with open(self.path, "a") as f:
+        with self._lock:
+            f = self._file()
+            # One write per record: O_APPEND makes concurrent writers
+            # interleave at whole-record granularity.
             f.write(json.dumps(rec) + "\n")
             f.flush()
             os.fsync(f.fileno())
         return rec
+
+    def close(self):
+        with self._lock:
+            self._close_handle()
+
+    def __del__(self):
+        try:
+            self._close_handle()
+        except Exception:
+            pass
 
     def read(self) -> List[dict]:
         return read_events(self.path)
@@ -65,12 +115,23 @@ def read_events(path) -> List[dict]:
     return out
 
 
+_ambient: Optional[EventLog] = None
+
+
 def default_log() -> Optional[EventLog]:
     """The ambient event log: ``$DTPU_EVENT_LOG`` (set by the supervisor for
-    every worker it launches), else None. Re-read per call — the supervisor
-    sets the variable after worker import time."""
+    every worker it launches), else None. The env var is re-read per call —
+    the supervisor sets it after worker import time — but the ``EventLog``
+    (and its cached append handle) is reused while the path is stable."""
+    global _ambient
     path = os.environ.get(ENV_VAR)
-    return EventLog(path) if path else None
+    if not path:
+        return None
+    if _ambient is None or str(_ambient.path) != path:
+        if _ambient is not None:
+            _ambient.close()
+        _ambient = EventLog(path)
+    return _ambient
 
 
 def emit(kind: str, **fields) -> Optional[dict]:

@@ -4,6 +4,15 @@ The reference's only observability is TF INFO logs + the Keras progress bar
 (/root/reference/README.md:395-412, 309-311). Here: a standard `logging`
 logger, chief-only by default (process 0), plus an optional JSONL event sink
 for machine-readable training telemetry.
+
+Multi-rank attribution: every record carries this process's
+``process_index``/``world_size`` — as a ``r<i>/<n>`` stamp on stderr lines
+(suppressed for single-process runs, so local output stays clean) and as
+fields on JSONL events — so interleaved gang stderr is attributable
+without grep archaeology. Rank resolution is jax-free at import (the
+supervisor's controller-process rule): it reads jax only if jax is
+already loaded, else falls back to the DTPU_CONFIG/TF_CONFIG cluster
+spec, else (0, 1).
 """
 
 from __future__ import annotations
@@ -11,13 +20,56 @@ from __future__ import annotations
 import json
 import logging
 import os
+import sys
 import time
-from typing import Optional
+from typing import Optional, Tuple
+
+
+def rank_world() -> Tuple[int, int]:
+    """(process_index, world_size) without forcing a jax import: a live
+    jax runtime wins (it knows about elastic resizes), else the
+    DTPU_CONFIG/TF_CONFIG env spec, else (0, 1). Cheap enough for per-log
+    calls; never raises."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            return int(jax_mod.process_index()), int(jax_mod.process_count())
+        except Exception:
+            pass
+    for var in ("DTPU_CONFIG", "TF_CONFIG"):
+        text = os.environ.get(var)
+        if not text:
+            continue
+        try:
+            obj = json.loads(text)
+            workers = obj["cluster"]["worker"]
+            return int(obj.get("task", {}).get("index", 0)), len(workers)
+        except Exception:
+            continue
+    return 0, 1
+
+
+class _RankFilter(logging.Filter):
+    """Attach the rank stamp to every record: `` r<i>/<n>`` in a gang,
+    empty single-process — attribution when it matters, clean output
+    when it doesn't."""
+
+    def filter(self, record):
+        rank, world = rank_world()
+        record.process_index = rank
+        record.world_size = world
+        record.rankstamp = f" r{rank}/{world}" if world > 1 else ""
+        return True
+
 
 _logger = logging.getLogger("distributed_tpu")
 if not _logger.handlers:
     h = logging.StreamHandler()
-    h.setFormatter(logging.Formatter("[dtpu %(asctime)s] %(message)s", "%H:%M:%S"))
+    h.setFormatter(
+        logging.Formatter("[dtpu %(asctime)s%(rankstamp)s] %(message)s",
+                          "%H:%M:%S")
+    )
+    h.addFilter(_RankFilter())
     _logger.addHandler(h)
     _level = os.environ.get("DTPU_LOG_LEVEL", "INFO").upper()
     _logger.setLevel(_level if _level in logging._nameToLevel else "INFO")
@@ -43,6 +95,8 @@ def set_jsonl(path: Optional[str]):
 def event(kind: str, **fields):
     """Emit a structured event (chief decides whether to call)."""
     if _jsonl_path:
-        rec = {"ts": time.time(), "event": kind, **fields}
+        rank, world = rank_world()
+        rec = {"ts": time.time(), "event": kind, "process_index": rank,
+               "world_size": world, **fields}
         with open(_jsonl_path, "a") as f:
             f.write(json.dumps(rec) + "\n")

@@ -184,22 +184,45 @@ class StepTimer:
         batch), ``dispatch`` (blocked on the device — donated dispatches
         wait out the previous step), ``checkpoint_wait`` (blocked on
         checkpoint saves/flushes). Free-form categories are allowed for
-        custom loops."""
+        custom loops.
+
+        Every attribution is ALSO accumulated into the obs metrics
+        registry (``stall_seconds/<category>`` counters) — the one-code-
+        path contract: whether the caller is the fit loop's spans, the
+        serving engine, or a checkpoint callback, stall accounting lands
+        in the same registry the exporters and cross-rank aggregation
+        read. Registry-disabled runs skip the forward (the bench's bare
+        half)."""
         self.stalls[category] = self.stalls.get(category, 0.0) + float(seconds)
+        from ..obs import registry as _obs_registry  # lazy: import order
+
+        if _obs_registry.enabled():
+            _obs_registry.default_registry().counter(
+                f"stall_seconds/{category}", seconds
+            )
 
     def stall_report(self) -> dict:
         """Attributed seconds per category, the timer's total lifetime
-        (``total_seconds``, wall clock since construction), and
-        ``input_stall_fraction`` = input_wait / total — the number
-        prefetching exists to drive to ~0. Unattributed time (callbacks,
-        Python bookkeeping, epoch sync) is the difference between the
-        categories' sum and the total."""
+        (``total_seconds``, wall clock since construction), per-category
+        fractions of that total (``<category>_fraction`` — the overlap
+        and obs benches read dispatch/checkpoint fractions, not just
+        input), the ``unattributed`` remainder (total minus the
+        categories' sum: callbacks, Python bookkeeping, epoch sync — an
+        honest residual instead of a silent one), and the legacy
+        ``input_stall_fraction`` (= ``input_wait_fraction``) that
+        ``bench.py overlap`` compares across prefetch depths."""
         elapsed = max(time.perf_counter() - self._wall0, 1e-9)
         out = {}
         for cat in ("input_wait", "dispatch", "checkpoint_wait"):
             out[cat] = round(self.stalls.get(cat, 0.0), 6)
         for cat, secs in self.stalls.items():
             out[cat] = round(secs, 6)
+        attributed = sum(out.values())
+        out["unattributed"] = round(max(elapsed - attributed, 0.0), 6)
+        for cat in list(out):
+            out[f"{cat}_fraction"] = round(
+                min(out[cat] / elapsed, 1.0), 6
+            )
         out["total_seconds"] = round(elapsed, 6)
         out["input_stall_fraction"] = round(out["input_wait"] / elapsed, 6)
         return out

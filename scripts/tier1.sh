@@ -145,6 +145,18 @@ if [ -n "${TIER1_RECOVERY_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_OBS_SMOKE=1: same idea for the observability runtime — runs the
+# registry/span/flight/aggregation/exporter/CLI tests plus the bench obs
+# schema smoke (~25 s) so obs/telemetry-surface changes iterate fast.
+# The real supervised straggler gang runs via `python bench.py obs`
+# (BENCH_obs.json). NOT a tier-1 substitute.
+if [ -n "${TIER1_OBS_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py \
+        "tests/test_bench.py::test_bench_obs_schema_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

@@ -302,6 +302,70 @@ def test_bench_recovery_schema_smoke(monkeypatch):
     assert bench.bench_recovery(repeats=1)["ok"] is False
 
 
+def test_bench_obs_schema_smoke(monkeypatch):
+    """Schema + gating smoke for `bench.py obs` WITHOUT spawning the
+    supervised gang (the recovery-smoke precedent): the gang helper is
+    replaced by a synthetic event factory, and the overhead pair runs
+    REAL but tiny (one interleaved bare/instrumented fit window through
+    the actual set_enabled toggle). The real supervised straggler gang
+    runs via `python bench.py obs` (BENCH_obs.json); the aggregation
+    math it relies on is pinned in-process by tests/test_obs.py."""
+
+    class _Res:
+        ok = True
+
+    def fake_gang(tmp, *, threshold=1.5, slow_seconds=0.25, **kw):
+        events = [
+            {"event": "rank_skew", "ts": 0.0, "world": 2, "max_skew": 3.0,
+             "slowest_rank": 1, "gang_median_step_s": 0.02, "ranks": []},
+            {"event": "straggler", "ts": 0.0, "rank": 1, "skew": 3.0,
+             "median_step_s": 0.06, "gang_median_step_s": 0.02,
+             "threshold": threshold, "world": 2},
+            {"event": "flight_dump", "ts": 0.0, "path": "/shm/f.jsonl"},
+        ]
+        return _Res(), events
+
+    monkeypatch.setattr(bench, "_obs_gang", fake_gang)
+    out = bench.bench_obs(global_batch=16, steps=4, windows=1)
+    assert out["metric"] == "obs_instrumentation_overhead_pct"
+    assert out["unit"] == "%"
+    o = out["overhead"]
+    assert o["bare_steps_per_sec"] > 0
+    assert o["instrumented_steps_per_sec"] > 0
+    assert len(o["window_bare"]) == len(o["window_instrumented"]) == 1
+    s = out["straggler"]
+    assert s["ok"] is True and s["detected_rank"] == 1 == s["injected_rank"]
+    assert s["flight_dumps"] == 1
+    # Gates flip honestly: a wrong-rank verdict or a >3% overhead fails.
+    def wrong_rank_gang(tmp, **kw):
+        res, events = fake_gang(tmp, **kw)
+        for e in events:
+            if e["event"] == "straggler":
+                e["rank"] = 0
+        return res, events
+
+    monkeypatch.setattr(bench, "_obs_gang", wrong_rank_gang)
+    monkeypatch.setattr(
+        bench, "_obs_overhead",
+        lambda **kw: {"bare_steps_per_sec": 100.0,
+                      "instrumented_steps_per_sec": 99.0,
+                      "window_bare": [100.0], "window_instrumented": [99.0],
+                      "overhead_pct": 1.0, "steps_per_window": 4,
+                      "windows": 1},
+    )
+    assert bench.bench_obs()["ok"] is False
+    monkeypatch.setattr(bench, "_obs_gang", fake_gang)
+    monkeypatch.setattr(
+        bench, "_obs_overhead",
+        lambda **kw: {"bare_steps_per_sec": 100.0,
+                      "instrumented_steps_per_sec": 90.0,
+                      "window_bare": [100.0], "window_instrumented": [90.0],
+                      "overhead_pct": 10.0, "steps_per_window": 4,
+                      "windows": 1},
+    )
+    assert bench.bench_obs()["ok"] is False
+
+
 def test_bench_output_contract(monkeypatch, capsys):
     """main() prints exactly one JSON line with the driver's schema."""
     monkeypatch.setattr(
