@@ -1,0 +1,49 @@
+"""Repo-aware static analysis (``dtpu-lint``).
+
+Machine-checks the framework's hardest-won cross-cutting invariants on
+every PR instead of re-discovering them in production postmortems:
+
+- ``jax-free-import``   — declared jax-free modules stay jax-free
+  through their TRANSITIVE module-scope import graph (imports.py);
+- ``writer-thread``     — ``dtpu-*writer`` background threads never
+  statically reach a collective (threads.py);
+- ``trace-purity``      — no host-impure calls inside jit-traced code
+  (purity.py);
+- ``event-schema``      — every ``emit(...)`` site agrees with the
+  declared event schema in ``utils/event_schema.py`` (events.py);
+- ``thread-hygiene``    — every ``threading.Thread`` is daemonized and
+  ``dtpu-*``-named (threads.py).
+
+Entry points: the ``dtpu-lint`` console script (cli.py, pyproject),
+``python -m distributed_tpu.analysis.cli``, and the library surface
+below (tests drive rules directly on synthetic trees). Catalog, escape
+hatches (``# dtpu-lint: allow[rule]`` comments, the checked-in baseline
+file) and the add-a-rule walk: docs/ANALYSIS.md.
+
+jax-free at import — the linter runs on controller and CI boxes and
+never imports the code it analyzes.
+"""
+
+from .core import (
+    Finding,
+    SourceTree,
+    apply_baseline,
+    load_baseline,
+    make_rules,
+    rule_names,
+    run_rules,
+    write_baseline,
+)
+from .imports import JAX_FREE_MODULES
+
+__all__ = [
+    "Finding",
+    "JAX_FREE_MODULES",
+    "SourceTree",
+    "apply_baseline",
+    "load_baseline",
+    "make_rules",
+    "rule_names",
+    "run_rules",
+    "write_baseline",
+]

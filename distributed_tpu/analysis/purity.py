@@ -1,0 +1,172 @@
+"""Rule ``trace-purity``: no host-impure calls inside jit-traced code.
+
+A ``jax.jit``-traced function runs ONCE per shape signature; host-side
+effects inside it (``time.*``, ``np.random.*``, ``random.*``,
+``os.environ`` reads, ``print``, ``.item()``/``float()`` on traced
+values) silently bake one trace-time value into the compiled program —
+the class of bug that reads as "works in eager, wrong/frozen under jit"
+and that cost PR 4 and PR 8 runtime drives to find (GSPMD placement
+drift and the DP de-replication were both invisible until a real run).
+
+Traced regions (heuristic, over-approximating):
+
+- functions decorated with ``jax.jit`` / ``functools.partial(jax.jit,
+  ...)``;
+- the resolved argument of any ``jax.jit(...)`` call — a local function
+  name, a ``lambda``, a ``functools.partial(fn, ...)``, or a
+  step-factory call like ``self._train_step_body()`` (the repo's
+  factory idiom: the factory's body, nested closures included, is
+  scanned);
+- scan bodies: the first argument of ``lax.scan(...)``;
+- any def whose name ends in ``_body`` (the ``_grad_eval_body`` /
+  ``*_train_step_body`` naming convention marks trace-scoped code).
+
+Factories legitimately do host work BEFORE building their closure;
+that is exactly what the ``# dtpu-lint: allow[trace-purity]`` escape is
+for — the comment documents, at the line, why the impurity is outside
+the trace or deliberate.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .core import Finding, SourceTree, call_name, dotted_name, register
+from .threads import function_index
+
+_JIT_NAMES = frozenset({"jax.jit", "jit"})
+_SCAN_NAMES = frozenset({"lax.scan", "jax.lax.scan"})
+
+
+def _resolve_traced(node, idx) -> Iterable[ast.AST]:
+    """AST regions traced for a jit/scan argument expression."""
+    if isinstance(node, ast.Lambda):
+        yield node
+    elif isinstance(node, ast.Name):
+        yield from idx.get(node.id, ())
+    elif isinstance(node, ast.Attribute):
+        yield from idx.get(node.attr, ())
+    elif isinstance(node, ast.Call):
+        dotted = call_name(node)
+        if dotted in ("functools.partial", "partial") and node.args:
+            yield from _resolve_traced(node.args[0], idx)
+        elif dotted is not None:
+            # factory idiom: jit(self._train_step_body()) — scan the
+            # factory's body (closure included)
+            yield from idx.get(dotted.split(".")[-1], ())
+
+
+def traced_regions(sf) -> List[ast.AST]:
+    idx = function_index(sf.tree)
+    regions: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node):
+        if id(node) not in seen:
+            seen.add(id(node))
+            regions.append(node)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.endswith("_body"):
+                add(node)
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d in _JIT_NAMES:
+                    add(node)
+                elif isinstance(dec, ast.Call):
+                    dc = call_name(dec)
+                    if dc in _JIT_NAMES:
+                        add(node)
+                    elif dc in ("functools.partial", "partial") and dec.args \
+                            and dotted_name(dec.args[0]) in _JIT_NAMES:
+                        add(node)
+        elif isinstance(node, ast.Call):
+            dotted = call_name(node)
+            if dotted in _JIT_NAMES and node.args:
+                for region in _resolve_traced(node.args[0], idx):
+                    add(region)
+            elif dotted in _SCAN_NAMES and node.args:
+                for region in _resolve_traced(node.args[0], idx):
+                    add(region)
+    return regions
+
+
+def _param_names(region) -> Set[str]:
+    if not isinstance(region, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+        return set()
+    a = region.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return set(names)
+
+
+def _impure_call(dotted: str) -> Optional[str]:
+    parts = dotted.split(".")
+    if parts[0] == "time" and len(parts) > 1:
+        return "wall-clock read"
+    if dotted.startswith(("np.random.", "numpy.random.")):
+        return "host RNG"
+    if parts[0] == "random" and len(parts) > 1:
+        return "host RNG"
+    if dotted in ("os.getenv",) or dotted.startswith("os.environ."):
+        return "environment read"
+    if dotted == "print":
+        return "host I/O"
+    if dotted in ("datetime.now", "datetime.datetime.now",
+                  "datetime.utcnow", "datetime.datetime.utcnow"):
+        return "wall-clock read"
+    return None
+
+
+@register
+class TracePurityRule:
+    name = "trace-purity"
+
+    def check(self, tree: SourceTree) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in tree.files:
+            for region in traced_regions(sf):
+                params = _param_names(region)
+                reported: Set[Tuple[int, str]] = set()
+
+                def flag(node, what, why):
+                    key = (node.lineno, what)
+                    if key in reported:
+                        return
+                    reported.add(key)
+                    findings.append(Finding(
+                        self.name, sf.rel, node.lineno,
+                        f"{why} '{what}' inside jit-traced code (the "
+                        f"value is baked at trace time and frozen into "
+                        f"the compiled program; hoist it to the host "
+                        f"side, or escape with "
+                        f"# dtpu-lint: allow[trace-purity])",
+                    ))
+
+                for node in ast.walk(region):
+                    if isinstance(node, ast.Call):
+                        dotted = call_name(node)
+                        if dotted is not None:
+                            why = _impure_call(dotted)
+                            if why is not None:
+                                flag(node, dotted, why)
+                                continue
+                        if isinstance(node.func, ast.Attribute) \
+                                and node.func.attr == "item":
+                            flag(node, ".item()", "host transfer")
+                        elif isinstance(node.func, ast.Name) \
+                                and node.func.id in ("float", "int") \
+                                and len(node.args) == 1 \
+                                and isinstance(node.args[0], ast.Name) \
+                                and node.args[0].id in params:
+                            flag(node, f"{node.func.id}(...)",
+                                 "host transfer of a traced argument")
+                    elif isinstance(node, ast.Attribute):
+                        if dotted_name(node) == "os.environ":
+                            flag(node, "os.environ", "environment read")
+        return findings

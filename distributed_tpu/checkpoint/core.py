@@ -409,6 +409,7 @@ class Checkpointer:
         files are skipped (warning + 'corrupt_checkpoint_skipped' event)
         and the scan falls back to the previous step — a torn latest file
         must cost one checkpoint interval of progress, not the run."""
+        from ..utils import event_schema as evs
         from ..utils import events as events_lib
         from ..utils import logging as dlog
 
@@ -424,7 +425,7 @@ class Checkpointer:
                     "falling back to the previous step"
                 )
                 events_lib.emit(
-                    "corrupt_checkpoint_skipped", step=int(step),
+                    evs.CORRUPT_CHECKPOINT_SKIPPED, step=int(step),
                     path=str(self._path(step)), error=str(e),
                 )
         raise FileNotFoundError(
@@ -488,6 +489,11 @@ class Checkpointer:
             except BaseException as e:  # surfaced at the next save/wait
                 self._writer_error = e
 
+        # save_npz -> flatten_tree -> _to_host CAN reach a multihost
+        # allgather, but never from here: _save_async is only entered
+        # under jax.process_count() == 1 (multi-process saves stay sync,
+        # see save()), so the snapshot is always fully addressable.
+        # dtpu-lint: allow[writer-thread]
         writer = threading.Thread(
             target=write, name="dtpu-ckpt-writer", daemon=True
         )

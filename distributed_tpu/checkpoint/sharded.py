@@ -643,6 +643,7 @@ class ShardedCheckpointer:
         self.wait()  # flush + commit any pending async save first
         if step is not None:
             return self._restore_step(model, int(step))
+        from ..utils import event_schema as evs
         from ..utils import events as events_lib
         from ..utils import logging as dlog
 
@@ -663,7 +664,7 @@ class ShardedCheckpointer:
                     f"({e}); falling back to the previous retained step"
                 )
                 events_lib.emit(
-                    "corrupt_checkpoint_skipped", step=int(cand),
+                    evs.CORRUPT_CHECKPOINT_SKIPPED, step=int(cand),
                     path=e.path or str(self._step_dir(cand)), error=str(e),
                 )
                 excluded.add(cand)

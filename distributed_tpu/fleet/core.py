@@ -44,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..serving.scheduler import Request, Sequence
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from .autoscale import QueueAutoscaler
 from .replica import DecodeReplica, EnginePrograms, PrefillReplica
@@ -286,7 +287,7 @@ class ServingFleet:
                             "decode_steps": rep.decode_steps,
                         })
                         events_lib.emit(
-                            "fleet_replica_killed", replica=name,
+                            evs.FLEET_REPLICA_KILLED, replica=name,
                             requeued=len(lost),
                         )
                         progressed = True

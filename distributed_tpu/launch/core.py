@@ -386,7 +386,8 @@ class SSHLauncher:
                 pass
 
         drains = [
-            threading.Thread(target=_drain, args=(i, p), daemon=True)
+            threading.Thread(target=_drain, args=(i, p), daemon=True,
+                             name=f"dtpu-ssh-drain-{i}")
             for i, p in enumerate(procs)
         ]
         for t in drains:

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+from ..utils import event_schema as evs
+
 DEFAULT_THRESHOLD = 1.5
 
 
@@ -49,7 +51,7 @@ def _median(values: Sequence[float]) -> Optional[float]:
 
 def snapshots(events: Sequence[dict]) -> List[dict]:
     """The ``metrics_snapshot`` records of an event stream, in order."""
-    return [e for e in events if e.get("event") == "metrics_snapshot"]
+    return [e for e in events if e.get("event") == evs.METRICS_SNAPSHOT]
 
 
 def rank_step_seconds(events: Sequence[dict]) -> dict:

@@ -24,6 +24,7 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
+from ..utils import event_schema as evs
 from ..utils.events import read_events
 from . import aggregate
 from .flight import read_dump
@@ -40,20 +41,20 @@ def summarize(events: List[dict], flight_paths=(),
               straggler_threshold: float = aggregate.DEFAULT_THRESHOLD
               ) -> dict:
     """The postmortem as data; ``render`` turns it into text."""
-    attempts = [e for e in events if e["event"] == "attempt_start"]
-    ends = [e for e in events if e["event"] == "attempt_end"]
-    faults = [e for e in events if e["event"] == "fault_injected"]
-    recoveries = [e for e in events if e["event"] == "recovery"]
-    resizes = [e for e in events if e["event"] == "gang_resize"]
+    attempts = [e for e in events if e["event"] == evs.ATTEMPT_START]
+    ends = [e for e in events if e["event"] == evs.ATTEMPT_END]
+    faults = [e for e in events if e["event"] == evs.FAULT_INJECTED]
+    recoveries = [e for e in events if e["event"] == evs.RECOVERY]
+    resizes = [e for e in events if e["event"] == evs.GANG_RESIZE]
     terminal = next(
         (e for e in reversed(events)
-         if e["event"] in ("run_complete", "budget_exhausted",
-                           "preemption_cap_exhausted")),
+         if e["event"] in (evs.RUN_COMPLETE, evs.BUDGET_EXHAUSTED,
+                           evs.PREEMPTION_CAP_EXHAUSTED)),
         None,
     )
     dump_paths: List[str] = [
         e["path"] for e in events
-        if e["event"] == "flight_dump" and e.get("path")
+        if e["event"] == evs.FLIGHT_DUMP and e.get("path")
     ]
     for p in flight_paths:
         if str(p) not in dump_paths:
@@ -96,7 +97,7 @@ def summarize(events: List[dict], flight_paths=(),
         "recoveries": recoveries,
         "rank_skew": aggregate.skew_report(events),
         "straggler": aggregate.straggler(events, straggler_threshold),
-        "straggler_events": [e for e in events if e["event"] == "straggler"],
+        "straggler_events": [e for e in events if e["event"] == evs.STRAGGLER],
         "flight_dumps": dumps,
     }
 

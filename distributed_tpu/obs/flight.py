@@ -34,6 +34,7 @@ import time
 from pathlib import Path
 from typing import List, Optional
 
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from ..utils.logging import rank_world
 from . import registry as registry_mod
@@ -105,7 +106,7 @@ class FlightRecorder:
             f.flush()
             os.fsync(f.fileno())
         events_lib.emit(
-            "flight_dump", path=str(path), reason=reason, rank=rank,
+            evs.FLIGHT_DUMP, path=str(path), reason=reason, rank=rank,
             records=len(records),
             attempt=_int_env("DTPU_ATTEMPT"),
         )

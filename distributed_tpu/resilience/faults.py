@@ -61,6 +61,7 @@ from pathlib import Path
 from typing import Optional
 
 from ..training.callbacks import Callback
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 
 ENV_VAR = "DTPU_FAULT"
@@ -258,7 +259,7 @@ class FaultInjector(Callback):
         if marker is not None:
             marker.parent.mkdir(parents=True, exist_ok=True)
             marker.touch()
-        events_lib.emit("fault_injected", mode=self.mode, step=int(step))
+        events_lib.emit(evs.FAULT_INJECTED, mode=self.mode, step=int(step))
         self._flight_dump(step)
         os._exit(self.exit_code)
 
@@ -280,7 +281,7 @@ class FaultInjector(Callback):
         if self.once_marker is not None:
             self.once_marker.parent.mkdir(parents=True, exist_ok=True)
             self.once_marker.touch()
-        events_lib.emit("fault_injected", mode=self.mode, step=int(step),
+        events_lib.emit(evs.FAULT_INJECTED, mode=self.mode, step=int(step),
                         replica=name)
         return True
 
@@ -305,7 +306,7 @@ class FaultInjector(Callback):
                     return
             if not self._slow_announced:
                 self._slow_announced = True
-                events_lib.emit("fault_injected", mode=self.mode,
+                events_lib.emit(evs.FAULT_INJECTED, mode=self.mode,
                                 step=int(step),
                                 slow_seconds=self.slow_seconds)
             time.sleep(self.slow_seconds)
@@ -317,7 +318,7 @@ class FaultInjector(Callback):
         if marker is not None:
             marker.parent.mkdir(parents=True, exist_ok=True)
             marker.touch()
-        events_lib.emit("fault_injected", mode=self.mode, step=int(step))
+        events_lib.emit(evs.FAULT_INJECTED, mode=self.mode, step=int(step))
         if self.mode in ("kill", "buddy_kill"):
             self._flight_dump(step)
             os._exit(self.exit_code)

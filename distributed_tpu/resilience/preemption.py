@@ -18,58 +18,29 @@ normal Python stack.
 
 from __future__ import annotations
 
-import json
-import os
 import signal
 import sys
-import time
 from pathlib import Path
 from typing import Optional
 
 from ..checkpoint import Checkpointer
-from ..checkpoint.core import _atomic_write
 from ..training.callbacks import Callback
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from ..utils import logging as dlog
 
-# EX_TEMPFAIL: "try again later" — distinct from any crash code, so the
-# supervisor can tell a clean preemption from a real failure.
-PREEMPTED_EXIT_CODE = 75
-
-RESUME_MARKER = "resume-marker.json"
-
-
-def marker_path(directory) -> Path:
-    return Path(directory) / RESUME_MARKER
-
-
-def write_resume_marker(directory, step: int, reason: str = "preempted") -> Path:
-    """Atomically record "this run stopped resumably at ``step``"."""
-    path = marker_path(directory)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    payload = json.dumps(
-        {"step": int(step), "reason": reason, "ts": time.time()}
-    )
-    _atomic_write(path, lambda tmp: Path(tmp).write_text(payload))
-    return path
-
-
-def read_resume_marker(directory) -> Optional[dict]:
-    """The marker dict, or None when absent/corrupt (a torn marker must
-    never block a restart — the checkpoint latest-pointer is the real
-    resume source; the marker is intent metadata)."""
-    try:
-        rec = json.loads(marker_path(directory).read_text())
-    except (OSError, json.JSONDecodeError):
-        return None
-    return rec if isinstance(rec, dict) and "step" in rec else None
-
-
-def clear_resume_marker(directory) -> None:
-    try:
-        marker_path(directory).unlink()
-    except OSError:
-        pass
+# The jax-free half (exit code + resume-marker I/O) lives in markers.py
+# so the supervisor's controller process never pulls this module (and
+# through Callback/Checkpointer, jax) at import; re-exported here for
+# the worker-side API surface.
+from .markers import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    RESUME_MARKER,
+    clear_resume_marker,
+    marker_path,
+    read_resume_marker,
+    write_resume_marker,
+)
 
 
 class PreemptionHandler(Callback):
@@ -150,7 +121,7 @@ class PreemptionHandler(Callback):
                 + (f"exiting with code {self.exit_code}" if self.exit_code
                    is not None else "stopping training in-process")
             )
-            events_lib.emit("preempted", step=int(step),
+            events_lib.emit(evs.PREEMPTED, step=int(step),
                             exit_code=self.exit_code)
         if self.exit_code is not None:
             self._uninstall()

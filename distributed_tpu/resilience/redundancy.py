@@ -450,6 +450,7 @@ class BuddyRedundancy:
         proc = jax.process_index()
 
         def write(tree):
+            from ..utils import event_schema as evs
             from ..utils import events as events_lib
             from ..utils import logging as dlog
             from . import faults as faults_lib
@@ -467,7 +468,7 @@ class BuddyRedundancy:
                         manifest,
                     )
                 self.last_refresh_step = step
-                events_lib.emit("buddy_refresh", step=step, rank=rank,
+                events_lib.emit(evs.BUDDY_REFRESH, step=step, rank=rank,
                                 world=world)
             except BaseException as e:
                 # Degrade the tier, not the run: recovery falls back to
@@ -478,7 +479,7 @@ class BuddyRedundancy:
                     f"({type(e).__name__}: {e}); the buddy tier is stale "
                     "until a refresh succeeds (disk fallback covers it)"
                 )
-                events_lib.emit("buddy_refresh_failed", step=step,
+                events_lib.emit(evs.BUDDY_REFRESH_FAILED, step=step,
                                 rank=rank, error=str(e))
 
         if self.async_refresh:

@@ -33,11 +33,15 @@ import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..launch.core import LocalLauncher, WorkerResult
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from ..utils import logging as dlog
 from .elastic import ElasticPolicy, FailureLedger
 from .policy import RestartPolicy
-from .preemption import (
+# From markers, NOT preemption: the handler module builds on
+# Callback/Checkpointer (jax at import) — the controller only needs the
+# jax-free marker I/O. Pinned by dtpu-lint's jax-free-import rule.
+from .markers import (
     PREEMPTED_EXIT_CODE,
     clear_resume_marker,
     read_resume_marker,
@@ -85,9 +89,9 @@ def recovery_rows(events: Sequence[dict]) -> List[dict]:
         return e.get("rank") in (None, 0)
 
     ends = {e.get("attempt"): e for e in events
-            if e["event"] == "attempt_end" and not e.get("ok", True)}
+            if e["event"] == evs.ATTEMPT_END and not e.get("ok", True)}
     starts = {e.get("attempt"): e for e in events
-              if e["event"] == "attempt_start"}
+              if e["event"] == evs.ATTEMPT_START}
     rows: List[dict] = []
     for attempt in sorted(a for a in ends if a is not None):
         nxt = attempt + 1
@@ -98,22 +102,22 @@ def recovery_rows(events: Sequence[dict]) -> List[dict]:
         window = [e for e in events
                   if starts[nxt]["ts"] <= e["ts"] <= t_next_end]
         fault = max((e for e in events
-                     if e["event"] == "fault_injected" and e["ts"] <= t_fail),
+                     if e["event"] == evs.FAULT_INJECTED and e["ts"] <= t_fail),
                     key=lambda e: e["ts"], default=None)
         rb = next((e for e in window
-                   if e["event"] == "restore_begin" and _rank0(e)), None)
+                   if e["event"] == evs.RESTORE_BEGIN and _rank0(e)), None)
         re_ = next((e for e in window
-                    if e["event"] == "restore_end" and _rank0(e)), None)
+                    if e["event"] == evs.RESTORE_END and _rank0(e)), None)
         ps = next((e for e in window
-                   if e["event"] == "post_restore_step" and _rank0(e)), None)
-        first = next((e for e in window if e["event"] == "first_step"), None)
+                   if e["event"] == evs.POST_RESTORE_STEP and _rank0(e)), None)
+        first = next((e for e in window if e["event"] == evs.FIRST_STEP), None)
 
         def span(a, b):
             return round(b["ts"] - a["ts"], 4) if (a and b) else None
 
         dumps = sorted({
             e["path"] for e in events
-            if e["event"] == "flight_dump" and e.get("path")
+            if e["event"] == evs.FLIGHT_DUMP and e.get("path")
             and (e.get("attempt") == attempt
                  or (e.get("attempt") is None and e["ts"] <= t_fail))
         })
@@ -364,7 +368,7 @@ class Supervisor:
         if cand is None or cand == world:
             return None
         if resizes >= self.elastic.max_resizes:
-            self._emit("resize_cap_exhausted", resizes=resizes,
+            self._emit(evs.RESIZE_CAP_EXHAUSTED, resizes=resizes,
                        wanted_world=cand)
             return None
         return cand, {
@@ -416,14 +420,14 @@ class Supervisor:
             cand = self.elastic.snap(int(self.elastic.probe()), launch_world)
             if cand is not None and cand != world:
                 resizes += 1
-                self._emit("gang_resize", from_world=world, to_world=cand,
+                self._emit(evs.GANG_RESIZE, from_world=world, to_world=cand,
                            reason="shrink" if cand < world else "grow",
                            trigger="probe", lost_ranks=[], attempt=0)
                 self._apply_resize(world, cand, ())
                 world = cand
         while True:
             attempt += 1
-            self._emit("attempt_start", attempt=attempt, world_size=world,
+            self._emit(evs.ATTEMPT_START, attempt=attempt, world_size=world,
                        restarts_used=restarts_used, preemptions=preemptions,
                        resizes=resizes)
             t0 = time.monotonic()
@@ -431,7 +435,7 @@ class Supervisor:
                                    **launch_kw)
             failed = [r for r in results if not r.ok]
             self._emit(
-                "attempt_end", attempt=attempt, ok=not failed,
+                evs.ATTEMPT_END, attempt=attempt, ok=not failed,
                 world_size=world,
                 duration=round(time.monotonic() - t0, 3),
                 failed_ranks=[r.index for r in failed],
@@ -441,7 +445,7 @@ class Supervisor:
                 if self.checkpoint_dir is not None:
                     clear_resume_marker(self.checkpoint_dir)
                 self._emit_recoveries()
-                self._emit("run_complete", attempts=attempt,
+                self._emit(evs.RUN_COMPLETE, attempts=attempt,
                            restarts_used=restarts_used,
                            preemptions=preemptions, resizes=resizes,
                            world_size=world)
@@ -453,7 +457,7 @@ class Supervisor:
             if preempted and self.policy.preemption_exempt:
                 if not self.policy.allows_preemption_restart(preemptions):
                     self._emit_recoveries()
-                    self._emit("preemption_cap_exhausted",
+                    self._emit(evs.PREEMPTION_CAP_EXHAUSTED,
                                preemptions=preemptions)
                     dlog.warning(
                         f"Supervisor: preemption cap "
@@ -471,7 +475,7 @@ class Supervisor:
             else:
                 if not self.policy.allows_restart(restarts_used):
                     self._emit_recoveries()
-                    self._emit("budget_exhausted",
+                    self._emit(evs.BUDGET_EXHAUSTED,
                                restarts_used=restarts_used,
                                max_restarts=self.policy.max_restarts)
                     dlog.warning(
@@ -487,7 +491,7 @@ class Supervisor:
                 new_world, info = resize
                 resizes += 1
                 ledger.reset()  # a re-formed gang renumbers its ranks
-                self._emit("gang_resize", from_world=world,
+                self._emit(evs.GANG_RESIZE, from_world=world,
                            to_world=new_world, attempt=attempt, **info)
                 dlog.warning(
                     f"Supervisor: {info['reason']} gang {world} -> "
@@ -506,7 +510,7 @@ class Supervisor:
                 # gang-kills keep their segments: those hosts are healthy.
                 self._invalidate_buddy_segments(failed)
             resume = self._resume_state()
-            self._emit("restart", attempt=attempt + 1, reason=reason,
+            self._emit(evs.RESTART, attempt=attempt + 1, reason=reason,
                        world_size=world, delay=delay,
                        restarts_used=restarts_used,
                        preemptions=preemptions, resizes=resizes, **resume)
@@ -532,7 +536,7 @@ class Supervisor:
 
         gone = BuddyStore(self.buddy_store_dir).invalidate_ranks(ranks)
         if gone:
-            self._emit("buddy_segments_invalidated", ranks=gone)
+            self._emit(evs.BUDDY_SEGMENTS_INVALIDATED, ranks=gone)
 
     def _resume_state(self) -> Dict[str, Optional[int]]:
         """What the relaunch is expected to resume from: the latest VALID
@@ -565,7 +569,7 @@ class Supervisor:
         try:
             events = self.event_log.read()
             for row in recovery_rows(events):
-                self._emit("recovery", **row)
+                self._emit(evs.RECOVERY, **row)
             self._emit_skew(events)
         except OSError:
             pass
@@ -576,13 +580,13 @@ class Supervisor:
         report = aggregate.skew_report(events)
         if report is None:
             return
-        self._emit("rank_skew", **report)
+        self._emit(evs.RANK_SKEW, **report)
         threshold = (self.straggler_threshold
                      if self.straggler_threshold is not None
                      else aggregate.DEFAULT_THRESHOLD)
         row = aggregate.straggler(events, threshold)
         if row is not None:
-            self._emit("straggler", **row)
+            self._emit(evs.STRAGGLER, **row)
             dlog.warning(
                 f"Supervisor: straggler rank {row['rank']} at "
                 f"{row['skew']}x the gang median step time "

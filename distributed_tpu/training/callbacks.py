@@ -25,6 +25,7 @@ import numpy as np
 
 from ..checkpoint import Checkpointer, ShardedCheckpointer
 from ..obs import spans as obs_spans
+from ..utils import event_schema as evs
 from ..utils import events as devents
 from ..utils import logging as dlog
 
@@ -177,7 +178,7 @@ class ModelCheckpoint(Callback):
             return  # neither tier has state: train from scratch
         rank = jax.process_index()
         attempt = os.environ.get("DTPU_ATTEMPT")
-        devents.emit("restore_begin", tier=tier, rank=rank,
+        devents.emit(evs.RESTORE_BEGIN, tier=tier, rank=rank,
                      attempt=int(attempt) if attempt else None)
         reads0 = dict(sharded_lib.read_stats)
         t0 = time.perf_counter()
@@ -190,7 +191,7 @@ class ModelCheckpoint(Callback):
             # lands on (possibly a fallback) is the one reported.
             step = self._timed(model, lambda: self.ckpt.restore_into(model))
         devents.emit(
-            "restore_end", tier=tier, step=int(step), rank=rank,
+            evs.RESTORE_END, tier=tier, step=int(step), rank=rank,
             seconds=round(time.perf_counter() - t0, 4),
             disk_block_reads=(sharded_lib.read_stats["block_reads"]
                               - reads0["block_reads"]),
@@ -249,7 +250,7 @@ class ModelCheckpoint(Callback):
             # First completed optimizer step after a tiered restore: the
             # recompile-time marker of the supervisor's MTTR breakdown.
             self._post_restore_pending = False
-            devents.emit("post_restore_step", step=int(step),
+            devents.emit(evs.POST_RESTORE_STEP, step=int(step),
                          rank=jax.process_index())
         if self._buddy is not None:
             bucket = step // self.buddy_refresh_every
@@ -651,6 +652,6 @@ class SyncCheck(Callback):
             # it ALSO lands in the resilience event log first: after the
             # supervisor's gang-kill + restart, the post-mortem names the
             # drifted parameter without trawling worker stderr.
-            devents.emit("sync_check_failed", epoch=int(epoch),
+            devents.emit(evs.SYNC_CHECK_FAILED, epoch=int(epoch),
                          step=int(model.step), error=str(e))
             raise

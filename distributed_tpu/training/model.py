@@ -47,6 +47,7 @@ from ..launch.core import heartbeat as _gang_heartbeat
 from ..obs import flight as obs_flight
 from ..obs import registry as obs_registry
 from ..obs import spans as obs_spans
+from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from ..utils import logging as dlog
 from ..utils.tree import tree_size
@@ -506,7 +507,7 @@ class Model:
             )
             self.state = self.strategy.put_params(self.state)
         summary = plan.summary()
-        events_lib.emit("auto_shard_plan", **summary)
+        events_lib.emit(evs.AUTO_SHARD_PLAN, **summary)
         if jax.process_index() == 0:
             dlog.event("auto_shard_plan", **summary)
             dlog.info(
@@ -1273,7 +1274,7 @@ class Model:
                 return
             if obs_registry.enabled() and events_lib.default_log() is not None:
                 events_lib.emit(
-                    "metrics_snapshot",
+                    evs.METRICS_SNAPSHOT,
                     rank=int(jax.process_index()),
                     world=int(jax.process_count()),
                     step=int(self.step),

@@ -1,4 +1,12 @@
-from . import compile_cache, events, logging, profiler, sync_check, tree
+from . import (
+    compile_cache,
+    event_schema,
+    events,
+    logging,
+    profiler,
+    sync_check,
+    tree,
+)
 from .sync_check import assert_replicas_identical, replica_drift
 
 __all__ = [

@@ -1,0 +1,219 @@
+"""Declared schema for the structured event log (``utils.events``).
+
+One module owns the vocabulary of the JSONL event stream: every event
+kind the framework emits, with the keys its consumers REQUIRE and the
+keys producers may optionally attach. Before this module the schema
+lived implicitly in three consumers — ``obs/cli.py`` (the postmortem
+renderer), ``obs/aggregate.py`` (cross-rank skew math), and
+``resilience/supervisor.recovery_rows`` (MTTR breakdown) — and drift
+between an emit site and those readers was only caught when a postmortem
+came back half-empty (the torn-tail class of bug, at the schema layer).
+
+Producers emit with the name constants (``emit(RESTORE_BEGIN, ...)``)
+and consumers filter with the same constants, so both sides reference
+one declaration. ``dtpu-lint``'s ``event-schema`` rule statically checks
+every ``emit(...)`` call site in the tree against :data:`EVENTS`:
+undeclared event names, missing required keys, and undeclared keys are
+lint errors (docs/ANALYSIS.md). The transport itself
+(:mod:`distributed_tpu.utils.events`) adds ``ts``/``event``/``pid`` to
+every record; those never appear here.
+
+STATIC CONTRACT: this module is parsed by ``dtpu-lint`` WITHOUT being
+imported (the linter must stay cheap and jax-free). Keep it literal —
+name constants are plain string assignments and :data:`EVENTS` is one
+dict literal of ``name: {"required": (...), "optional": (...)}`` rows
+(plus ``"extra": True`` for events whose payload is an open record,
+e.g. a plan summary). No computed keys, no comprehensions.
+
+jax-free at import (checked by dtpu-lint's jax-free-import rule).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+# --------------------------------------------------------------- names
+# Supervisor lifecycle (resilience/supervisor.py).
+ATTEMPT_START = "attempt_start"
+ATTEMPT_END = "attempt_end"
+RESTART = "restart"
+RUN_COMPLETE = "run_complete"
+BUDGET_EXHAUSTED = "budget_exhausted"
+PREEMPTION_CAP_EXHAUSTED = "preemption_cap_exhausted"
+RESIZE_CAP_EXHAUSTED = "resize_cap_exhausted"
+GANG_RESIZE = "gang_resize"
+RECOVERY = "recovery"
+RANK_SKEW = "rank_skew"
+STRAGGLER = "straggler"
+BUDDY_SEGMENTS_INVALIDATED = "buddy_segments_invalidated"
+
+# Worker-side lifecycle (callbacks, faults, preemption, redundancy).
+FAULT_INJECTED = "fault_injected"
+PREEMPTED = "preempted"
+CORRUPT_CHECKPOINT_SKIPPED = "corrupt_checkpoint_skipped"
+RESTORE_BEGIN = "restore_begin"
+RESTORE_END = "restore_end"
+POST_RESTORE_STEP = "post_restore_step"
+FIRST_STEP = "first_step"
+SYNC_CHECK_FAILED = "sync_check_failed"
+BUDDY_REFRESH = "buddy_refresh"
+BUDDY_REFRESH_FAILED = "buddy_refresh_failed"
+
+# Observability (obs/flight.py, training/model.py snapshot flush).
+FLIGHT_DUMP = "flight_dump"
+METRICS_SNAPSHOT = "metrics_snapshot"
+
+# Planner + fleet.
+AUTO_SHARD_PLAN = "auto_shard_plan"
+FLEET_REPLICA_KILLED = "fleet_replica_killed"
+
+
+# -------------------------------------------------------------- schema
+# required: keys every emit site must pass literally (consumers index
+#           them unconditionally, or the row is useless without them).
+# optional: keys a producer may attach; consumers .get() them.
+# extra:    True for open-payload events (the producer spreads a whole
+#           summary dict — key drift there is the payload's own schema).
+EVENTS: Dict[str, dict] = {
+    ATTEMPT_START: {
+        "required": ("attempt", "world_size"),
+        "optional": ("restarts_used", "preemptions", "resizes"),
+    },
+    ATTEMPT_END: {
+        "required": ("attempt", "ok", "world_size"),
+        "optional": ("duration", "failed_ranks", "exit_codes"),
+    },
+    RESTART: {
+        "required": ("attempt", "reason"),
+        "optional": ("world_size", "delay", "restarts_used", "preemptions",
+                     "resizes", "resume_step", "marker_step"),
+    },
+    RUN_COMPLETE: {
+        "required": ("attempts",),
+        "optional": ("restarts_used", "preemptions", "resizes",
+                     "world_size"),
+    },
+    BUDGET_EXHAUSTED: {
+        "required": ("restarts_used",),
+        "optional": ("max_restarts",),
+    },
+    PREEMPTION_CAP_EXHAUSTED: {
+        "required": ("preemptions",),
+        "optional": (),
+    },
+    RESIZE_CAP_EXHAUSTED: {
+        "required": ("resizes",),
+        "optional": ("wanted_world",),
+    },
+    GANG_RESIZE: {
+        "required": ("from_world", "to_world", "reason", "trigger"),
+        "optional": ("lost_ranks", "attempt"),
+    },
+    RECOVERY: {
+        "required": ("failed_attempt", "recovered_attempt"),
+        "optional": ("flight_dumps", "detect_s", "gang_reform_s",
+                     "restore_s", "recompile_s", "restore_tier",
+                     "restore_step", "disk_block_reads",
+                     "total_to_first_step_s"),
+    },
+    RANK_SKEW: {
+        "required": ("ranks", "world", "gang_median_step_s", "max_skew",
+                     "slowest_rank"),
+        "optional": (),
+    },
+    STRAGGLER: {
+        "required": ("rank", "skew", "median_step_s", "gang_median_step_s",
+                     "threshold", "world"),
+        "optional": (),
+    },
+    BUDDY_SEGMENTS_INVALIDATED: {
+        "required": ("ranks",),
+        "optional": (),
+    },
+    FAULT_INJECTED: {
+        "required": ("mode", "step"),
+        "optional": ("replica", "slow_seconds"),
+    },
+    PREEMPTED: {
+        "required": ("step",),
+        "optional": ("exit_code",),
+    },
+    CORRUPT_CHECKPOINT_SKIPPED: {
+        "required": ("step", "path"),
+        "optional": ("error",),
+    },
+    RESTORE_BEGIN: {
+        "required": ("tier", "rank"),
+        "optional": ("attempt",),
+    },
+    RESTORE_END: {
+        "required": ("tier", "step", "rank", "seconds"),
+        "optional": ("disk_block_reads", "disk_block_bytes", "attempt"),
+    },
+    POST_RESTORE_STEP: {
+        "required": ("step", "rank"),
+        "optional": (),
+    },
+    # Consumed by recovery_rows as a fallback recompile marker for streams
+    # that predate post_restore_step; no in-tree producer today.
+    FIRST_STEP: {
+        "required": (),
+        "optional": ("step", "rank"),
+    },
+    SYNC_CHECK_FAILED: {
+        "required": ("epoch", "step"),
+        "optional": ("error",),
+    },
+    BUDDY_REFRESH: {
+        "required": ("step", "rank"),
+        "optional": ("world",),
+    },
+    BUDDY_REFRESH_FAILED: {
+        "required": ("step", "rank"),
+        "optional": ("error",),
+    },
+    FLIGHT_DUMP: {
+        "required": ("path",),
+        "optional": ("reason", "rank", "records", "attempt"),
+    },
+    METRICS_SNAPSHOT: {
+        "required": ("rank", "step_seconds"),
+        "optional": ("world", "step", "self_seconds"),
+    },
+    AUTO_SHARD_PLAN: {
+        # The whole Plan.summary() dict — the planner's own schema.
+        "required": (),
+        "optional": (),
+        "extra": True,
+    },
+    FLEET_REPLICA_KILLED: {
+        "required": ("replica",),
+        "optional": ("requeued",),
+    },
+}
+
+
+def required_keys(name: str) -> Tuple[str, ...]:
+    return tuple(EVENTS[name]["required"])
+
+
+def optional_keys(name: str) -> Tuple[str, ...]:
+    return tuple(EVENTS[name].get("optional", ()))
+
+
+def allows_extra(name: str) -> bool:
+    return bool(EVENTS[name].get("extra", False))
+
+
+__all__ = [
+    "EVENTS", "allows_extra", "optional_keys", "required_keys",
+    # name constants
+    "ATTEMPT_START", "ATTEMPT_END", "RESTART", "RUN_COMPLETE",
+    "BUDGET_EXHAUSTED", "PREEMPTION_CAP_EXHAUSTED", "RESIZE_CAP_EXHAUSTED",
+    "GANG_RESIZE", "RECOVERY", "RANK_SKEW", "STRAGGLER",
+    "BUDDY_SEGMENTS_INVALIDATED", "FAULT_INJECTED", "PREEMPTED",
+    "CORRUPT_CHECKPOINT_SKIPPED", "RESTORE_BEGIN", "RESTORE_END",
+    "POST_RESTORE_STEP", "FIRST_STEP", "SYNC_CHECK_FAILED",
+    "BUDDY_REFRESH", "BUDDY_REFRESH_FAILED", "FLIGHT_DUMP",
+    "METRICS_SNAPSHOT", "AUTO_SHARD_PLAN", "FLEET_REPLICA_KILLED",
+]

@@ -145,6 +145,16 @@ if [ -n "${TIER1_RECOVERY_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_ANALYSIS_SMOKE=1: same idea for the static analyzer — runs the
+# dtpu-lint rule/runner tests plus the full-tree lint gate (~10 s) so
+# rule/schema/manifest changes iterate fast. NOT a tier-1 substitute.
+if [ -n "${TIER1_ANALYSIS_SMOKE:-}" ]; then
+    env JAX_PLATFORMS=cpu python -m distributed_tpu.analysis.cli || exit 1
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 # TIER1_OBS_SMOKE=1: same idea for the observability runtime — runs the
 # registry/span/flight/aggregation/exporter/CLI tests plus the bench obs
 # schema smoke (~25 s) so obs/telemetry-surface changes iterate fast.
@@ -160,6 +170,19 @@ fi
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"
+
+# Lint gate BEFORE pytest: the repo-aware invariants (jax-free imports,
+# writer-thread discipline, trace purity, event schema, thread hygiene —
+# docs/ANALYSIS.md) fail in ~2 s instead of surfacing as a runtime
+# regression 13 minutes in. Exit 4 distinguishes a lint failure from
+# pytest's own statuses (124 timeout / 3 budget).
+echo "dtpu-lint: checking tree invariants (scripts/lint.sh)"
+if ! env JAX_PLATFORMS=cpu python -m distributed_tpu.analysis.cli; then
+    echo "tier-1: dtpu-lint gate failed (fix the findings, allowlist at" \
+         "the source line, or baseline with --write-baseline)" >&2
+    exit 4
+fi
+
 start=$(date +%s)
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors --durations=15 \

@@ -36,9 +36,32 @@ from distributed_tpu.utils import compile_cache as _compile_cache  # noqa: E402
 
 _compile_cache.enable()
 
+import contextlib  # noqa: E402
 import threading  # noqa: E402
 
 import pytest  # noqa: E402
+
+
+@contextlib.contextmanager
+def assert_no_recompile(*jitted):
+    """Pin the no-recompile contract of fixed-shape dispatch paths: the
+    body must not grow ANY of the given ``jax.jit`` objects' compile
+    caches (``_cache_size()``). The serving/rl discipline — host-side
+    toggles (logprob capture, weight hot-swaps) ride the SAME compiled
+    programs — stated once here instead of hand-counting ``_cache_size``
+    in each test::
+
+        with assert_no_recompile(engine._decode_jit, engine._prefill_jit):
+            engine.run(requests)  # must reuse the compiled dispatches
+    """
+    before = [f._cache_size() for f in jitted]
+    yield
+    after = [f._cache_size() for f in jitted]
+    grew = [
+        f"jit #{i}: {b} -> {a} compiles"
+        for i, (b, a) in enumerate(zip(before, after)) if a != b
+    ]
+    assert not grew, "unexpected recompile(s): " + "; ".join(grew)
 
 
 @pytest.fixture(scope="session")

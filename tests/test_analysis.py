@@ -1,0 +1,537 @@
+"""dtpu-lint: rule units on synthetic trees, baseline round-trip, CLI
+exit codes, and the real-tree gate (clean + fast).
+
+Each rule gets a violating, a clean, and an allowlisted fixture — the
+seeded-violation cases double as the acceptance check that an injected
+regression of any of the five invariants fails the lint (ISSUE 15).
+Everything here is AST-only (no jax dispatch), so the whole file stays
+far under the 10s in-tier budget.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from distributed_tpu.analysis import cli as lint_cli
+from distributed_tpu.analysis import core
+from distributed_tpu.analysis.events import EventSchemaRule
+from distributed_tpu.analysis.imports import ImportGraph, JaxFreeImportRule
+from distributed_tpu.analysis.purity import TracePurityRule
+from distributed_tpu.analysis.threads import ThreadHygieneRule, WriterThreadRule
+
+
+def write_tree(root: Path, files: dict) -> Path:
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return root
+
+
+def run_rule(rule, root: Path):
+    tree = core.SourceTree([root])
+    assert not tree.errors, tree.errors
+    return core.run_rules(tree, [rule])
+
+
+# ------------------------------------------------------------ fixtures
+# One violating tree per rule, reused by the unit tests AND the CLI
+# exit-code acceptance matrix. `args` are extra CLI flags the rule needs.
+VIOLATING = {
+    "jax-free-import": dict(
+        files={
+            "pkg/__init__.py": "",
+            "pkg/a.py": "from . import b\n",
+            "pkg/b.py": "from . import c\n",
+            "pkg/c.py": "import jax\n",
+        },
+        args=["--jax-free", "pkg.a"],
+    ),
+    "writer-thread": dict(
+        files={
+            "pkg/__init__.py": "",
+            "pkg/w.py": """
+                import threading
+                from jax.experimental import multihost_utils
+
+                def flush():
+                    multihost_utils.sync_global_devices("x")
+
+                def helper():
+                    flush()
+
+                def write():
+                    helper()
+
+                def start():
+                    t = threading.Thread(target=write, daemon=True,
+                                         name="dtpu-test-writer")
+                    t.start()
+            """,
+        },
+        args=[],
+    ),
+    "trace-purity": dict(
+        files={
+            "pkg/__init__.py": "",
+            "pkg/t.py": """
+                import time
+
+                import jax
+
+                def step(x):
+                    t = time.time()
+                    return x * t
+
+                f = jax.jit(step)
+            """,
+        },
+        args=[],
+    ),
+    "event-schema": dict(
+        files={
+            "pkg/__init__.py": "",
+            "pkg/event_schema.py": """
+                FOO = "foo"
+                EVENTS = {
+                    FOO: {"required": ("a", "b"), "optional": ("c",)},
+                    "open": {"required": (), "optional": (), "extra": True},
+                }
+            """,
+            "pkg/p.py": """
+                def emit(kind, **fields):
+                    pass
+
+                emit("foo", a=1)
+            """,
+        },
+        args=[],
+    ),
+    "thread-hygiene": dict(
+        files={
+            "pkg/__init__.py": "",
+            "pkg/h.py": """
+                import threading
+
+                def go():
+                    threading.Thread(target=go, daemon=True).start()
+            """,
+        },
+        args=[],
+    ),
+}
+
+
+# ------------------------------------------------------- jax-free-import
+class TestJaxFreeImport:
+    def test_transitive_violation_with_chain(self, tmp_path):
+        root = write_tree(tmp_path, VIOLATING["jax-free-import"]["files"])
+        rule = JaxFreeImportRule(manifest=["pkg.a"])
+        out = run_rule(rule, root)
+        assert len(out) == 1
+        f = out[0]
+        assert f.path == "pkg/a.py" and f.line == 1
+        assert "pkg.b -> pkg.c -> jax" in f.message
+
+    def test_clean_and_lazy_imports_pass(self, tmp_path):
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "from . import b\n",
+            # function-scope jax is the sanctioned lazy idiom
+            "pkg/b.py": "import json\n\ndef f():\n    import jax\n",
+        })
+        assert run_rule(JaxFreeImportRule(manifest=["pkg.a"]), root) == []
+
+    def test_symbol_import_falls_back_to_package_init(self, tmp_path):
+        # `from .sub import thing` runs sub/__init__ — an import jax there
+        # poisons every declared importer above it.
+        root = write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/a.py": "from .sub import thing\n",
+            "pkg/sub/__init__.py": "import jax\nthing = 1\n",
+        })
+        out = run_rule(JaxFreeImportRule(manifest=["pkg.a"]), root)
+        assert len(out) == 1 and "pkg.sub -> jax" in out[0].message
+
+    def test_allowlist_comment_suppresses(self, tmp_path):
+        files = dict(VIOLATING["jax-free-import"]["files"])
+        files["pkg/a.py"] = (
+            "from . import b  # dtpu-lint: allow[jax-free-import]\n"
+        )
+        root = write_tree(tmp_path, files)
+        assert run_rule(JaxFreeImportRule(manifest=["pkg.a"]), root) == []
+
+    def test_manifest_typo_is_reported_on_full_scans(self, tmp_path):
+        root = write_tree(tmp_path, {"pkg/__init__.py": "", "pkg/a.py": ""})
+        out = run_rule(JaxFreeImportRule(manifest=["pkg.zzz"]), root)
+        assert len(out) == 1 and "unknown module 'pkg.zzz'" in out[0].message
+        # ...but a fixture/partial scan of an unrelated package stays quiet
+        out = run_rule(JaxFreeImportRule(manifest=["other.mod"]), root)
+        assert out == []
+
+    def test_real_manifest_modules_exist_and_import_graph_holds(self):
+        # The declared manifest must match the real tree (typo guard) and
+        # the real tree must be clean — the dogfood contract.
+        pkg = Path(lint_cli.__file__).resolve().parents[1]
+        tree = core.SourceTree([pkg])
+        out = core.run_rules(tree, [JaxFreeImportRule()])
+        assert out == [], "\n".join(f.render() for f in out)
+        # spot-check the load-bearing chain this PR fixed: the supervisor
+        # no longer reaches jax through preemption's Callback machinery
+        g = ImportGraph(tree)
+        assert g.chain_to("distributed_tpu.resilience.supervisor",
+                          ("jax", "jaxlib")) is None
+
+
+# -------------------------------------------------------- writer-thread
+class TestWriterThread:
+    def test_transitive_collective_flagged_at_thread_site(self, tmp_path):
+        root = write_tree(tmp_path, VIOLATING["writer-thread"]["files"])
+        out = run_rule(WriterThreadRule(), root)
+        assert len(out) == 1
+        f = out[0]
+        assert f.path == "pkg/w.py"
+        assert "dtpu-test-writer" in f.message
+        assert "write -> helper -> flush" in f.message
+        assert "sync_global_devices" in f.message
+
+    def test_jnp_dispatch_flagged_and_numpy_clean(self, tmp_path):
+        mk = """
+            import threading
+            import {mod} as xp
+
+            def write():
+                xp.zeros(3)
+
+            t = threading.Thread(target=write, daemon=True,
+                                 name="dtpu-x-writer")
+        """
+        root = write_tree(tmp_path, {"a/j.py": mk.format(mod="jax.numpy")})
+        # `import jax.numpy as xp` dispatches as xp.* — covered via jnp
+        root2 = write_tree(tmp_path / "2", {"a/j.py": mk.replace(
+            "import {mod} as xp", "import jax.numpy as jnp"
+        ).replace("xp.zeros", "jnp.zeros")})
+        assert len(run_rule(WriterThreadRule(), root2)) == 1
+        root3 = write_tree(tmp_path / "3", {"a/j.py": mk.replace(
+            "import {mod} as xp", "import numpy as np"
+        ).replace("xp.zeros", "np.zeros")})
+        assert run_rule(WriterThreadRule(), root3) == []
+
+    def test_non_writer_threads_ignored(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            import threading
+            from jax.experimental import multihost_utils
+
+            def work():
+                multihost_utils.sync_global_devices("x")
+
+            t = threading.Thread(target=work, daemon=True,
+                                 name="dtpu-prefetch")
+        """})
+        assert run_rule(WriterThreadRule(), root) == []
+
+    def test_allowlist_at_thread_line(self, tmp_path):
+        files = dict(VIOLATING["writer-thread"]["files"])
+        files["pkg/w.py"] = files["pkg/w.py"].replace(
+            "t = threading.Thread(",
+            "# dtpu-lint: allow[writer-thread]\n"
+            "                    t = threading.Thread(",
+        )
+        root = write_tree(tmp_path, files)
+        assert run_rule(WriterThreadRule(), root) == []
+
+
+# --------------------------------------------------------- trace-purity
+class TestTracePurity:
+    def test_jit_call_argument_time_read(self, tmp_path):
+        root = write_tree(tmp_path, VIOLATING["trace-purity"]["files"])
+        out = run_rule(TracePurityRule(), root)
+        assert len(out) == 1
+        assert "time.time" in out[0].message
+        assert out[0].path == "pkg/t.py"
+
+    @pytest.mark.parametrize("body,needle", [
+        ("np.random.rand(3)", "np.random.rand"),
+        ("os.environ.get('X')", "os.environ"),
+        ("print(x)", "print"),
+        ("x.item()", ".item()"),
+        ("float(x)", "float(...)"),
+    ])
+    def test_impure_families_in_decorated_fn(self, tmp_path, body, needle):
+        root = write_tree(tmp_path, {"a/m.py": f"""
+            import os
+
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                {body}
+                return x
+        """})
+        out = run_rule(TracePurityRule(), root)
+        assert out and needle in out[0].message
+
+    def test_body_suffix_and_scan_idioms(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            import time
+
+            from jax import lax
+
+            def _train_step_body():
+                def step(c, x):
+                    return c, time.perf_counter()
+                return step
+
+            def outer(xs):
+                def body(c, x):
+                    return c, time.monotonic()
+                return lax.scan(body, 0.0, xs)
+        """})
+        out = run_rule(TracePurityRule(), root)
+        assert {f.message.split("'")[1] for f in out} == {
+            "time.perf_counter", "time.monotonic",
+        }
+
+    def test_clean_and_allowlisted(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def step(x):
+                return jnp.tanh(x) * 2.0
+        """})
+        assert run_rule(TracePurityRule(), root) == []
+        root2 = write_tree(tmp_path / "2", {"a/m.py": """
+            import time
+
+            import jax
+
+            @jax.jit
+            def step(x):
+                t = time.time()  # dtpu-lint: allow[trace-purity]
+                return x * t
+        """})
+        assert run_rule(TracePurityRule(), root2) == []
+
+    def test_real_tree_is_clean(self):
+        pkg = Path(lint_cli.__file__).resolve().parents[1]
+        out = core.run_rules(core.SourceTree([pkg]), [TracePurityRule()])
+        assert out == [], "\n".join(f.render() for f in out)
+
+
+# --------------------------------------------------------- event-schema
+class TestEventSchema:
+    SCHEMA = VIOLATING["event-schema"]["files"]["pkg/event_schema.py"]
+
+    def _root(self, tmp_path, producer):
+        return write_tree(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/event_schema.py": self.SCHEMA,
+            "pkg/p.py": producer,
+        })
+
+    def test_missing_required_key(self, tmp_path):
+        root = self._root(tmp_path, "import x\nx.log.emit('foo', a=1)\n")
+        out = run_rule(EventSchemaRule(), root)
+        assert len(out) == 1 and "missing required key(s) b" in out[0].message
+
+    def test_undeclared_event_and_undeclared_key(self, tmp_path):
+        root = self._root(
+            tmp_path,
+            "def emit(k, **f):\n    pass\n\n"
+            "emit('bar', x=1)\n"
+            "emit('foo', a=1, b=2, d=3)\n",
+        )
+        out = run_rule(EventSchemaRule(), root)
+        msgs = " | ".join(f.message for f in out)
+        assert "undeclared event 'bar'" in msgs
+        assert "undeclared key(s) d" in msgs
+
+    def test_constant_reference_and_clean_sites(self, tmp_path):
+        root = self._root(
+            tmp_path,
+            "from .event_schema import FOO\n"
+            "def emit(k, **f):\n    pass\n\n"
+            "emit(FOO, a=1, b=2, c=3)\n"      # constant name, full keys
+            "emit('open', anything=1)\n"      # extra=True event
+            "emit('foo', **row)\n"            # spread: opaque, name-checked
+            "def fwd(kind):\n    emit(kind, a=1)\n",  # dynamic: skipped
+        )
+        assert run_rule(EventSchemaRule(), root) == []
+
+    def test_spread_with_bad_event_name_still_caught(self, tmp_path):
+        root = self._root(tmp_path,
+                          "def emit(k, **f):\n    pass\n\nemit('nope', **r)\n")
+        out = run_rule(EventSchemaRule(), root)
+        assert len(out) == 1 and "undeclared event 'nope'" in out[0].message
+
+    def test_allowlist(self, tmp_path):
+        root = self._root(
+            tmp_path,
+            "def emit(k, **f):\n    pass\n\n"
+            "emit('foo', a=1)  # dtpu-lint: allow[event-schema]\n",
+        )
+        assert run_rule(EventSchemaRule(), root) == []
+
+    def test_real_tree_emit_sites_match_declared_schema(self):
+        # The dogfood acceptance: every emit site in the package agrees
+        # with utils/event_schema.py (producers were migrated to the
+        # declared constants in this PR).
+        pkg = Path(lint_cli.__file__).resolve().parents[1]
+        out = core.run_rules(core.SourceTree([pkg]), [EventSchemaRule()])
+        assert out == [], "\n".join(f.render() for f in out)
+
+    def test_schema_constants_round_trip_the_live_module(self):
+        # The statically-parsed schema equals the imported module — the
+        # linter and the runtime can never disagree about the vocabulary.
+        from distributed_tpu.analysis.events import load_schema
+        from distributed_tpu.utils import event_schema as live
+        pkg = Path(lint_cli.__file__).resolve().parents[1]
+        schemas, constants = load_schema(core.SourceTree([pkg]))
+        assert set(schemas) == set(live.EVENTS)
+        for name, row in schemas.items():
+            assert row["required"] == tuple(live.EVENTS[name]["required"])
+            assert row["optional"] == tuple(
+                live.EVENTS[name].get("optional", ())
+            )
+            assert row["extra"] == bool(live.EVENTS[name].get("extra", False))
+        assert constants["RESTORE_BEGIN"] == live.RESTORE_BEGIN
+
+
+# ------------------------------------------------------- thread-hygiene
+class TestThreadHygiene:
+    def test_unnamed_and_nondaemon(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            import threading
+
+            def go():
+                pass
+
+            threading.Thread(target=go)
+        """})
+        out = run_rule(ThreadHygieneRule(), root)
+        msgs = " | ".join(f.message for f in out)
+        assert len(out) == 2
+        assert "daemon=True" in msgs and "name='dtpu-*'" in msgs
+
+    def test_fstring_name_and_bare_thread_import(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            from threading import Thread
+
+            def go():
+                pass
+
+            for i in range(2):
+                Thread(target=go, daemon=True, name=f"dtpu-drain-{i}")
+            Thread(target=go, daemon=True, name="worker-1")
+        """})
+        out = run_rule(ThreadHygieneRule(), root)
+        assert len(out) == 1 and "name='dtpu-*'" in out[0].message
+
+    def test_kwargs_spread_and_allowlist(self, tmp_path):
+        root = write_tree(tmp_path, {"a/m.py": """
+            import threading
+
+            def go(**kw):
+                threading.Thread(target=go, **kw)
+                # dtpu-lint: allow[thread-hygiene]
+                threading.Thread(target=go, daemon=True)
+        """})
+        assert run_rule(ThreadHygieneRule(), root) == []
+
+
+# -------------------------------------------------- baseline round-trip
+class TestBaseline:
+    def test_round_trip_suppresses_then_unsuppresses(self, tmp_path):
+        root = write_tree(tmp_path, VIOLATING["thread-hygiene"]["files"])
+        tree = core.SourceTree([root])
+        findings = core.run_rules(tree, [ThreadHygieneRule()])
+        assert findings
+        bl = tmp_path / "baseline.txt"
+        core.write_baseline(bl, findings)
+        kept, suppressed = core.apply_baseline(
+            findings, core.load_baseline(bl)
+        )
+        assert kept == [] and suppressed == len(findings)
+        # a NEW finding (different message/path) is not shadowed
+        extra = core.Finding("thread-hygiene", "pkg/new.py", 3, "Thread(x)")
+        kept, suppressed = core.apply_baseline(
+            findings + [extra], core.load_baseline(bl)
+        )
+        assert kept == [extra]
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert core.load_baseline(tmp_path / "nope") == []
+
+
+# ------------------------------------------------------------------ CLI
+class TestCli:
+    @pytest.mark.parametrize("rule", sorted(VIOLATING))
+    def test_seeded_violation_exits_nonzero(self, tmp_path, capsys, rule):
+        """Acceptance: injecting any of the five rule fixtures flips the
+        exit code — the tier-1 gate catches each invariant class."""
+        spec = VIOLATING[rule]
+        root = write_tree(tmp_path / "scan", spec["files"])
+        rc = lint_cli.main([str(root)] + spec["args"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert f" {rule} " in out  # path:line: RULE-ID message
+        assert "finding(s)" in out
+
+    def test_clean_tree_exits_zero(self, tmp_path, capsys):
+        root = write_tree(tmp_path / "scan", {"pkg/ok.py": "x = 1\n"})
+        assert lint_cli.main([str(root)]) == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        spec = VIOLATING["thread-hygiene"]
+        root = write_tree(tmp_path / "scan", spec["files"])
+        assert lint_cli.main([str(root)]) == 1
+        assert lint_cli.main([str(root), "--write-baseline"]) == 0
+        assert (tmp_path / ".dtpu-lint-baseline").exists()
+        rc = lint_cli.main([str(root)])
+        out = capsys.readouterr().out
+        assert rc == 0 and "(1 baselined)" in out
+
+    def test_rule_subset_and_errors(self, tmp_path, capsys):
+        spec = VIOLATING["thread-hygiene"]
+        root = write_tree(tmp_path / "scan", spec["files"])
+        # the violating rule excluded -> clean
+        assert lint_cli.main([str(root), "--rules", "event-schema"]) == 0
+        assert lint_cli.main([str(root), "--rules", "nope"]) == 2
+        assert lint_cli.main([str(tmp_path / "missing")]) == 2
+        root2 = write_tree(tmp_path / "bad", {"pkg/x.py": "def broken(:\n"})
+        assert lint_cli.main([str(root2)]) == 2
+        capsys.readouterr()
+
+    def test_list_rules_names_all_five(self, capsys):
+        assert lint_cli.main(["--list-rules"]) == 0
+        out = capsys.readouterr().out.split()
+        assert out == ["event-schema", "jax-free-import", "thread-hygiene",
+                       "trace-purity", "writer-thread"]
+
+    def test_json_output(self, tmp_path, capsys):
+        spec = VIOLATING["trace-purity"]
+        root = write_tree(tmp_path / "scan", spec["files"])
+        rc = lint_cli.main([str(root), "--json"])
+        rows = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert rows and rows[0]["rule"] == "trace-purity"
+        assert set(rows[0]) == {"rule", "path", "line", "message"}
+
+    def test_full_real_tree_clean_and_fast(self, capsys):
+        """The shipped acceptance gate: dtpu-lint exits 0 on the repo
+        (all findings fixed or allowlisted) and a full-tree run stays
+        well under 10s."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        rc = lint_cli.main([])
+        elapsed = _time.perf_counter() - t0
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert elapsed < 10.0, f"full-tree lint took {elapsed:.1f}s"

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import assert_no_recompile
+
 import distributed_tpu as dtpu
 from distributed_tpu.serving import (
     BlockAllocator, Engine, PagedKVCache, Request,
@@ -238,8 +240,6 @@ def test_logprob_capture_rides_fixed_dispatch_no_recompile(lm, sampler):
     reqs = lambda: [Request(p, m, seed=i)
                     for i, (p, m) in enumerate(zip(prompts, news))]
     outs = sampler.run(reqs(), return_logprobs=True)
-    decode_compiles = sampler._decode_jit._cache_size()
-    prefill_compiles = sampler._prefill_jit._cache_size()
     rows_by_order = sampler.last_run_telemetry["requests"]  # submit order
     # Teacher-force both served rows in ONE padded predict (one compile):
     # captured logprob == log_softmax of the model's logits at the
@@ -258,9 +258,8 @@ def test_logprob_capture_rides_fixed_dispatch_no_recompile(lm, sampler):
             got = lps[t - (p.size - 1)]
             assert abs(want - got) < 1e-4, (t, want, got)
     # Toggling capture OFF reuses the exact same compiled programs.
-    sampler.run(reqs())
-    assert sampler._decode_jit._cache_size() == decode_compiles
-    assert sampler._prefill_jit._cache_size() == prefill_compiles
+    with assert_no_recompile(sampler._decode_jit, sampler._prefill_jit):
+        sampler.run(reqs())
     assert "logprobs" not in sampler.last_run_telemetry["requests"][0]
 
 
@@ -295,16 +294,16 @@ def test_update_weights_staleness_contract(lm):
     prompts, news = _requests(seed=4, n=1, p_range=(4, 5), m_range=(8, 9))
     engine = Engine(lm, max_slots=1, block_size=4, max_len=64)
     base = engine.run([Request(prompts[0], news[0])])[0]
-    compiles = engine._decode_jit._cache_size()
     same = jax.tree_util.tree_map(lambda a: a, lm.params)
 
     def swap(eng, step):
         if step == 3:
             eng.update_weights(same)
 
-    out = engine.run([Request(prompts[0], news[0])], on_decode_step=swap)[0]
+    with assert_no_recompile(engine._decode_jit):
+        out = engine.run([Request(prompts[0], news[0])],
+                         on_decode_step=swap)[0]
     np.testing.assert_array_equal(base, out)
-    assert engine._decode_jit._cache_size() == compiles
     row = engine.last_run_telemetry["requests"][0]
     # Prefill token + 3 decode tokens under v0, the rest under v1.
     assert row["weights_versions"] == [
