@@ -47,6 +47,7 @@ from ..serving.scheduler import Request, Sequence
 from ..utils import event_schema as evs
 from ..utils import events as events_lib
 from .autoscale import QueueAutoscaler
+from .handoff import trim_kv
 from .replica import DecodeReplica, EnginePrograms, PrefillReplica
 from .router import Router
 
@@ -68,6 +69,13 @@ class ServingFleet:
     a transfer path — the decode replica re-prefills every context (the
     documented fallback; same tokens, more compute). ``prefill_replicas=0``
     colocates prefill on the decode replicas (the engine's own layout).
+
+    ``prefix_cache=True`` gives every decode replica a refcounted prefix
+    store (``serving.kv_cache.PrefixStore``): the router places requests
+    by prefix affinity, admission adopts cached prompt blocks, and
+    handoff payloads are TRIMMED to the non-cached suffix before
+    shipping (``fleet.handoff.trim_kv``) — telemetry reports the bytes
+    saved.
     """
 
     def __init__(self, model, *, decode_replicas: int = 2,
@@ -76,6 +84,7 @@ class ServingFleet:
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  transfer: str = "blocks",
+                 prefix_cache: bool = False,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None, seed: int = 0,
                  router: Optional[Router] = None,
@@ -112,6 +121,7 @@ class ServingFleet:
         self.num_blocks = num_blocks
         self.prefill_chunk = prefill_chunk
         self.transfer = transfer
+        self.prefix_cache = bool(prefix_cache)
         self.eos_id = eos_id
         self.router = router or Router()
         self.autoscaler = autoscaler
@@ -147,7 +157,7 @@ class ServingFleet:
             name, self.programs, max_slots=self.max_slots,
             block_size=self.block_size, max_len=self.max_len,
             num_blocks=self.num_blocks, prefill_chunk=self.prefill_chunk,
-            eos_id=self.eos_id,
+            eos_id=self.eos_id, prefix_cache=self.prefix_cache,
         )
         alloc = time.perf_counter() - t0
         self.spinup_measured_s = max(self.spinup_measured_s, alloc)
@@ -171,6 +181,7 @@ class ServingFleet:
             "preemptions": rep.preemptions,
             "handoffs_installed": rep.handoffs_installed,
             "handoffs_fallback": rep.handoffs_fallback,
+            "handoffs_trim_stale": rep.handoffs_trim_stale,
             "busy_s": round(rep.busy_s, 4),
             "alive": rep.alive,
         }
@@ -251,6 +262,9 @@ class ServingFleet:
         pending_handoff: List[list] = []  # [ready_at, seq, payload]
         kills: List[dict] = []
         fallback_dispatches = 0  # re-prefills: transfer off / replica lost
+        handoff_bytes_full = 0     # payload bytes before suffix trimming
+        handoff_bytes_shipped = 0  # payload bytes actually transferred
+        suffix_trims = 0           # payloads that shipped suffix-only
         queue_peak = 0
         ttft_recent: List[float] = []
 
@@ -363,10 +377,10 @@ class ServingFleet:
                 progressed = True
             for item in dispatchable:
                 _, seq, payload = item
-                target = min(
+                target = self.router.place(
+                    seq,
                     (r for r in self.decode_pool.values()
                      if self._ready(r, now) and r.free_slots > 0),
-                    key=lambda r: (r.in_flight, r.name), default=None,
                 )
                 if target is None:
                     # No capacity: hold as pending, re-offered next pass.
@@ -376,6 +390,15 @@ class ServingFleet:
                     # Prefilled (or partially decoded) elsewhere but the
                     # KV could not travel: the decode side re-prefills.
                     fallback_dispatches += 1
+                if payload is not None:
+                    # Ship only the suffix the target's prefix store does
+                    # not already hold. Trimming is per-target (stores
+                    # differ), so it happens at placement, not at pack.
+                    handoff_bytes_full += payload.nbytes
+                    payload, skipped = trim_kv(payload, target.kv.prefix)
+                    handoff_bytes_shipped += payload.nbytes
+                    if skipped:
+                        suffix_trims += 1
                 target.submit(seq, now, payload=payload)
                 seq.replica = target.name
                 progressed = True
@@ -436,6 +459,8 @@ class ServingFleet:
         self._finalize_telemetry(
             reqs, seqs_in_order, admitted, results, kills, queue_peak,
             fallback_dispatches, wall_s=time.perf_counter() - wall0,
+            handoff_bytes=(handoff_bytes_full, handoff_bytes_shipped,
+                           suffix_trims),
         )
         out = FleetResult(
             results.get(r.request_id) for r in reqs
@@ -446,7 +471,7 @@ class ServingFleet:
     # ----------------------------------------------------------- telemetry
     def _finalize_telemetry(self, reqs, seqs_in_order, admitted, results,
                             kills, queue_peak, fallback_dispatches,
-                            wall_s):
+                            wall_s, handoff_bytes=(0, 0, 0)):
         fins = [s for s in admitted.values()
                 if s.request.request_id in results]
         ttfts = [s.first_token_at - s.submitted_at for s in fins]
@@ -522,6 +547,13 @@ class ServingFleet:
                 "fallback_reprefill": fallback_dispatches + sum(
                     r["handoffs_fallback"] for r in rows.values()
                 ),
+                "trim_stale": sum(
+                    r["handoffs_trim_stale"] for r in rows.values()
+                ),
+                "bytes_full": int(handoff_bytes[0]),
+                "bytes_shipped": int(handoff_bytes[1]),
+                "bytes_saved": int(handoff_bytes[0] - handoff_bytes[1]),
+                "suffix_trims": int(handoff_bytes[2]),
             },
             "preemptions": sum(r["preemptions"] for r in rows.values()),
             "decode_steps": sum(r["decode_steps"] for r in rows.values()),
@@ -544,4 +576,6 @@ class ServingFleet:
         reg.gauge("fleet/tokens_per_sec", tel["tokens_per_sec"])
         reg.gauge("fleet/queue_depth_peak", queue_peak)
         reg.gauge("fleet/decode_replicas", len(self.decode_pool))
+        reg.gauge("fleet/handoff_bytes_saved",
+                  tel["handoffs"]["bytes_saved"])
         self.last_run_telemetry = reg.set_report("fleet.run", tel)

@@ -19,12 +19,23 @@ pools disagree on block size / dtype / layer structure,
 back to RE-PREFILLING the context on the decode replica — the scheduler's
 preemption-requeue semantics (token-exact under greedy), paid as recompute
 instead of transfer.
+
+**Suffix-only shipping.** When the decode side runs a prefix store
+(``PagedKVCache(prefix_cache=True)``), the sender attaches the prompt's
+chain hashes (``serving.kv_cache._chain_hashes`` — the prefix store's own
+keys) to the payload, and :func:`trim_kv` drops the leading blocks the
+receiver already holds: only the non-cached SUFFIX travels. The receiver's
+admission adopts the cached prefix out of its store (refcounted, CoW on
+divergence) and :func:`install_kv` scatters just the shipped tail. If the
+store evicted between trim and admission, the receiver detects the gap
+(``payload.skip_blocks`` exceeds its adopted span) and falls back to
+re-prefill — never a silent hole in the cache.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +43,11 @@ import numpy as np
 
 from ..checkpoint.core import iter_leaf_paths
 from ..checkpoint.sharded import _block_key, _parse_key
+from ..serving.kv_cache import _chain_hashes
 
-__all__ = ["KVHandoff", "HandoffIncompatible", "pack_kv", "install_kv"]
+__all__ = [
+    "KVHandoff", "HandoffIncompatible", "pack_kv", "install_kv", "trim_kv",
+]
 
 
 class HandoffIncompatible(ValueError):
@@ -66,22 +80,38 @@ class KVHandoff:
     for that attention layer, in logical order. ``cached_len`` is the
     number of POSITIONS cached (the prefilled context; the first generated
     token's KV is NOT included — its row is written by the receiver's
-    first decode step, mirroring the engine's post-prefill state)."""
+    first decode step, mirroring the engine's post-prefill state).
+
+    ``dtype`` is descriptive (the last pool leaf's dtype, for telemetry);
+    compatibility is checked per leaf at install time, because a
+    quantized int8 pool flattens to MIXED leaves (int8 ``q`` plus float32
+    ``scale``) that no single dtype string can gate.
+
+    ``prefix_hashes`` are the prompt's chain hashes (one per FULL block,
+    ``serving.kv_cache._chain_hashes``) and ``skip_blocks`` how many
+    leading blocks :func:`trim_kv` dropped because the receiver's prefix
+    store already held them (0 = full payload)."""
 
     blocks: Dict[str, np.ndarray]
     cached_len: int
     block_size: int
     dtype: str
+    prefix_hashes: tuple = ()
+    skip_blocks: int = 0
 
     @property
     def nbytes(self) -> int:
         return int(sum(a.nbytes for a in self.blocks.values()))
 
 
-def pack_kv(kv, slot: int, cached_len: int) -> KVHandoff:
+def pack_kv(kv, slot: int, cached_len: int, tokens=None) -> KVHandoff:
     """Gather ``slot``'s first ``blocks_for(cached_len)`` blocks out of
     every layer pool into one host payload. One fancy-index gather per
-    layer leaf; block ids never leave the owning pool."""
+    layer leaf; block ids never leave the owning pool.
+
+    ``tokens``: the cached context's token ids; when given, the payload
+    carries their chain hashes so a prefix-caching receiver can
+    :func:`trim_kv` the blocks it already holds."""
     n = kv.blocks_for(cached_len)
     ids = np.asarray(kv._slot_blocks[slot][:n], np.int32)
     if len(ids) < n:
@@ -96,8 +126,14 @@ def pack_kv(kv, slot: int, cached_len: int) -> KVHandoff:
         data = np.asarray(jax.device_get(pool[ids]))
         dtype = str(pool.dtype)
         blocks[_block_key(path, (0,) * data.ndim, data.shape)] = data
+    hashes = ()
+    if tokens is not None:
+        hashes = tuple(_chain_hashes(
+            [int(t) for t in tokens[:cached_len]], kv.block_size
+        ))
     return KVHandoff(blocks=blocks, cached_len=int(cached_len),
-                     block_size=int(kv.block_size), dtype=dtype or "")
+                     block_size=int(kv.block_size), dtype=dtype or "",
+                     prefix_hashes=hashes)
 
 
 def install_kv(kv, slot: int, payload: KVHandoff):
@@ -117,6 +153,10 @@ def install_kv(kv, slot: int, payload: KVHandoff):
             f"slot {slot} has {len(ids)} reserved blocks but the payload "
             f"covers {need} — reserve the sequence's context first"
         )
+    if not payload.blocks:
+        # Fully trimmed: every cached block was adopted from the
+        # receiver's prefix store; nothing travels, nothing to scatter.
+        return 0
     paths, leaves, treedef = _cache_leaves(kv.caches)
     by_path: Dict[str, list] = {}
     for key, data in payload.blocks.items():
@@ -132,12 +172,15 @@ def install_kv(kv, slot: int, payload: KVHandoff):
     installed = 0
     new_leaves = []
     for path, pool in zip(paths, leaves):
-        if str(pool.dtype) != payload.dtype:
-            raise HandoffIncompatible(
-                f"dtype mismatch on {path}: payload {payload.dtype} vs "
-                f"pool {pool.dtype}"
-            )
         for start, data in sorted(by_path[path]):
+            # Per-LEAF dtype gate: an int8 pool's leaves are int8 ``q``
+            # plus float32 ``scale`` — each shipped run must match its
+            # own destination leaf, not one payload-wide dtype string.
+            if str(pool.dtype) != str(data.dtype):
+                raise HandoffIncompatible(
+                    f"dtype mismatch on {path}: payload {data.dtype} vs "
+                    f"pool {pool.dtype}"
+                )
             run = np.asarray(ids[start:start + data.shape[0]], np.int32)
             pool = pool.at[jnp.asarray(run)].set(
                 jnp.asarray(data, pool.dtype)
@@ -146,3 +189,41 @@ def install_kv(kv, slot: int, payload: KVHandoff):
         new_leaves.append(pool)
     kv.caches = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return installed
+
+
+def trim_kv(payload: KVHandoff, store) -> Tuple[KVHandoff, int]:
+    """Drop the leading blocks the receiving replica's prefix ``store``
+    already holds (a contiguous chain-hash hit run), returning
+    ``(trimmed payload, blocks dropped)``. The original payload is not
+    mutated — a failed placement can be re-offered to a different
+    replica, whose store may hold a different prefix.
+
+    The trimmed payload records ``skip_blocks`` so the receiver can
+    verify at install time that its store STILL covers the gap (eviction
+    may race the transfer) and fall back to re-prefill otherwise."""
+    if store is None or not payload.prefix_hashes or not payload.blocks:
+        return payload, 0
+    skip = 0
+    for key in payload.prefix_hashes:
+        if key in store:
+            skip += 1
+        else:
+            break
+    n_blocks = -(-payload.cached_len // payload.block_size)
+    skip = min(skip, n_blocks)
+    if skip == 0:
+        return payload, 0
+    blocks: Dict[str, np.ndarray] = {}
+    for key, data in payload.blocks.items():
+        path, starts, _shape = _parse_key(key)
+        first = starts[0] if starts else 0
+        if data.shape[0] + first <= skip:
+            continue  # this run is entirely inside the cached prefix
+        keep = max(skip - first, 0)
+        rest = data[keep:]
+        new_starts = (first + keep,) + tuple(starts[1:])
+        blocks[_block_key(path, new_starts, rest.shape)] = rest
+    trimmed = dataclasses.replace(
+        payload, blocks=blocks, skip_blocks=int(skip)
+    )
+    return trimmed, int(skip)

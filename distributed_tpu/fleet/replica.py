@@ -38,7 +38,7 @@ import numpy as np
 from ..serving.engine import (
     _decode_dispatch, _mix_seed, _prefill_dispatch, _token_key,
 )
-from ..serving.kv_cache import PagedKVCache
+from ..serving.kv_cache import PagedKVCache, _chain_hashes
 from ..serving.scheduler import Scheduler, Sequence
 from .handoff import HandoffIncompatible, KVHandoff, install_kv, pack_kv
 
@@ -104,7 +104,8 @@ class _ReplicaBase:
 
     def __init__(self, name: str, programs: EnginePrograms, *,
                  max_slots: int, block_size: int, max_len: int,
-                 num_blocks: Optional[int] = None):
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = False):
         self.name = name
         self.programs = programs
         model = programs.model
@@ -118,7 +119,7 @@ class _ReplicaBase:
             model.module, model.params,
             max_slots=self.max_slots, block_size=self.block_size,
             max_blocks_per_seq=nb_per_seq, num_blocks=int(num_blocks),
-            dtype=model.decode_dtype(),
+            dtype=model.decode_dtype(), prefix_cache=bool(prefix_cache),
         )
         self.alive = True
         self.busy_until = 0.0  # this replica's own (virtual) timeline
@@ -181,7 +182,9 @@ class PrefillReplica(_ReplicaBase):
             last = (total - 1 - start) if i == len(chunks) - 1 else c - 1
             tok, dt = self._run_prefill_chunk(seq, start, c, last)
             spent += dt
-        payload = pack_kv(self.kv, 0, total)
+        # Chain hashes ride along so a prefix-caching decode replica can
+        # trim the payload to the non-cached suffix (fleet.handoff).
+        payload = pack_kv(self.kv, 0, total, tokens=seq.tokens[:total])
         self.kv.release(0)
         seq.slot = None
         seq.tokens.append(int(tok))
@@ -203,10 +206,11 @@ class DecodeReplica(_ReplicaBase):
                  max_slots: int, block_size: int, max_len: int,
                  num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 prefix_cache: bool = False):
         super().__init__(name, programs, max_slots=max_slots,
                          block_size=block_size, max_len=max_len,
-                         num_blocks=num_blocks)
+                         num_blocks=num_blocks, prefix_cache=prefix_cache)
         self.prefill_chunk = (
             int(prefill_chunk) if prefill_chunk is not None else None
         )
@@ -219,6 +223,7 @@ class DecodeReplica(_ReplicaBase):
         self.preemptions = 0
         self.handoffs_installed = 0
         self.handoffs_fallback = 0
+        self.handoffs_trim_stale = 0  # trimmed prefix evicted pre-admit
 
     # ------------------------------------------------------------ signals
     @property
@@ -244,6 +249,18 @@ class DecodeReplica(_ReplicaBase):
     @property
     def has_work(self) -> bool:
         return not self.sched.idle or bool(self._prefill_jobs)
+
+    def holds_prefix(self, seq: Sequence) -> bool:
+        """True when this replica's prefix store already caches the
+        sequence's leading prompt block — the router's placement
+        affinity signal (a hit means warm-cache admission and, under
+        block transfer, a suffix-only payload)."""
+        store = self.kv.prefix
+        if store is None:
+            return False
+        keys = _chain_hashes(seq.tokens[:self.block_size],
+                             self.block_size)
+        return bool(keys) and keys[0] in store
 
     # ----------------------------------------------------------- lifecycle
     def submit(self, seq: Sequence, now: float,
@@ -277,25 +294,42 @@ class DecodeReplica(_ReplicaBase):
             if seq.admitted_at is None:
                 seq.admitted_at = now
             payload = self._handoffs.pop(seq.request.request_id, None)
+            if (payload is not None and payload.skip_blocks > 0
+                    and payload.skip_blocks * self.kv.block_size
+                    > seq.cached_len):
+                # The payload was trimmed against this store, but the
+                # trimmed prefix was evicted before admission could
+                # adopt it: the shipped suffix no longer joins up with
+                # resident blocks. Re-prefill instead of leaving a hole.
+                self.handoffs_trim_stale += 1
+                self.handoffs_fallback += 1
+                payload = None
             if payload is not None:
                 try:
                     install_kv(self.kv, seq.slot, payload)
                     # Post-prefill engine state: positions = cached
                     # context, last token decodes next.
                     self.kv.positions[seq.slot] = payload.cached_len
+                    if self.kv.prefix is not None:
+                        self.kv.insert_prefix(
+                            seq.slot, seq.tokens[:seq.prompt_len]
+                        )
                     self.handoffs_installed += 1
                     continue
                 except HandoffIncompatible:
                     self.handoffs_fallback += 1
             # No payload (transfer off, replica lost, or preempted here):
-            # prefill the WHOLE current context — prompt plus any tokens
-            # generated before the requeue — and sample the next token
-            # from its last position, exactly the engine's re-admission
-            # path. Greedy parity makes the recompute token-exact.
+            # prefill the current context — prompt plus any tokens
+            # generated before the requeue, minus positions the prefix
+            # store already adopted (seq.cached_len) — and sample the
+            # next token from its last position, exactly the engine's
+            # re-admission path. Greedy parity makes the recompute
+            # token-exact.
             total = seq.context_len
-            step = self.prefill_chunk or total
+            begin = min(seq.cached_len, total - 1)
+            step = self.prefill_chunk or (total - begin)
             chunks = [
-                (s, min(step, total - s)) for s in range(0, total, step)
+                (s, min(step, total - s)) for s in range(begin, total, step)
             ]
             self._prefill_jobs.append([seq, chunks, 0])
 
@@ -345,6 +379,10 @@ class DecodeReplica(_ReplicaBase):
                 if job[2] == len(chunks):
                     self._prefill_jobs.pop(0)
                     self.kv.positions[seq.slot] = total
+                    if self.kv.prefix is not None:
+                        self.kv.insert_prefix(
+                            seq.slot, seq.tokens[:seq.prompt_len]
+                        )
                     seq.tokens.append(tok)
                     seq.num_generated += 1
                     if seq.first_token_at is None:

@@ -176,6 +176,27 @@ class Router:
         self._vt = max(self._vt, seq.vft)
         return seq
 
+    # ------------------------------------------------------------ placement
+    def place(self, seq: Sequence, candidates):
+        """Pick the decode replica for ``seq`` from ``candidates``
+        (replicas with capacity): PREFIX AFFINITY first — a replica whose
+        prefix store already holds the sequence's leading prompt block
+        (``DecodeReplica.holds_prefix``) admits it with a warm cache and,
+        under block transfer, receives a trimmed suffix-only payload —
+        then least in-flight, then name (deterministic tie-break). Without
+        prefix caching every replica scores equal affinity and this is
+        exactly the old least-loaded rule."""
+        pool = list(candidates)
+        if not pool:
+            return None
+
+        def key(rep):
+            holds = getattr(rep, "holds_prefix", None)
+            affinity = 1 if holds is not None and holds(seq) else 0
+            return (-affinity, rep.in_flight, rep.name)
+
+        return min(pool, key=key)
+
     # ----------------------------------------------------------- telemetry
     def telemetry(self) -> dict:
         return {

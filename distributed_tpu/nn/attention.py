@@ -25,7 +25,37 @@ import jax.numpy as jnp
 from . import initializers
 from .core import Layer, Shape
 from ..precision import resolve_dtype
-from ..quant import maybe_dequantize, shape_of
+from ..quant import _QMAX, QKEY, SKEY, dequantize, maybe_dequantize, shape_of
+
+
+def _kv_block_size(pool) -> int:
+    """Block size of one paged layer pool — plain K/V array or an int8
+    ``{"q","scale"}`` quantized pair (quant.py's plain-dict idiom)."""
+    return (pool[QKEY] if isinstance(pool, dict) else pool).shape[1]
+
+
+def _kv_scatter(pool, blk, off, rows):
+    """Scatter freshly-computed K/V ``rows`` (..., H, hd) into
+    ``pool[blk, off]`` (index arrays share the rows' leading shape).
+
+    Plain pools write the rows as-is (cast to the pool dtype). int8 pools
+    quantize ON SCATTER, row-wise: unlike weight quantization (one static
+    scale per output channel — ``quant.quantize_leaf``), KV rows are
+    data-dependent per position, so each (position, head) row gets its own
+    dynamic scale ``amax(|row|)/127`` stored alongside the int8 payload.
+    All-zero rows get scale 1 so the dequant stays finite — and the trash
+    block, which is never written by a live slot, dequantizes to exact
+    zeros."""
+    if not isinstance(pool, dict):
+        return pool.at[blk, off].set(rows.astype(pool.dtype))
+    r = rows.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(r), axis=-1, keepdims=True)  # (..., H, 1)
+    scale = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(jnp.round(r / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return {
+        QKEY: pool[QKEY].at[blk, off].set(q),
+        SKEY: pool[SKEY].at[blk, off].set(scale),
+    }
 
 
 class MultiHeadAttention(Layer):
@@ -271,16 +301,38 @@ class MultiHeadAttention(Layer):
         inner = shape_of(params["wq"])[1]
         hd = inner // self.num_heads
         shape = (num_blocks, block_size, self.num_heads, hd)
+        if dtype is not None and jnp.dtype(dtype) == jnp.dtype("int8"):
+            # int8 KV: ~4x fewer pool bytes than f32 (scale adds 1/hd
+            # overhead). Same {"q","scale"} plain-dict idiom as quantized
+            # weights, but with per-(position, head) DYNAMIC scales
+            # (_kv_scatter) — KV values are data-dependent per step, so a
+            # static per-channel scale cannot serve them.
+            return {
+                "k": {QKEY: jnp.zeros(shape, jnp.int8),
+                      SKEY: jnp.ones(shape[:-1] + (1,), jnp.float32)},
+                "v": {QKEY: jnp.zeros(shape, jnp.int8),
+                      SKEY: jnp.ones(shape[:-1] + (1,), jnp.float32)},
+            }
         cdtype = self.dtype or dtype
         return {
             "k": jnp.zeros(shape, cdtype),
             "v": jnp.zeros(shape, cdtype),
         }
 
-    def _paged_view(self, pool, block_tables):
+    def _paged_view(self, pool, block_tables, out_dtype=None):
         """Gather per-slot blocks into a contiguous (S, nb*bs, H, hd) view
         (logical position j of slot s lives at block_tables[s, j // bs],
-        offset j % bs)."""
+        offset j % bs). Plain pools return their own dtype (``out_dtype``
+        ignored — the f32/bf16 program is unchanged); int8 pools gather
+        q + scale and dequantize IN-TRACE to ``out_dtype``."""
+        if isinstance(pool, dict):
+            return dequantize(
+                {
+                    QKEY: self._paged_view(pool[QKEY], block_tables),
+                    SKEY: self._paged_view(pool[SKEY], block_tables),
+                },
+                out_dtype,
+            )
         gathered = pool[block_tables]  # (S, nb, bs, H, hd)
         s, nb, bs, h, hd = gathered.shape
         return gathered.reshape(s, nb * bs, h, hd)
@@ -305,7 +357,7 @@ class MultiHeadAttention(Layer):
         s = x.shape[0]
         h = self.num_heads
         hd = shape_of(params["wq"])[1] // h
-        bs = cache["k"].shape[1]
+        bs = _kv_block_size(cache["k"])
         q = self._proj(params, x, "wq", "bq").reshape(s, 1, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(s, h, hd)
         v = self._proj(params, x, "wv", "bv").reshape(s, h, hd)
@@ -313,10 +365,10 @@ class MultiHeadAttention(Layer):
             block_tables, (positions // bs)[:, None], axis=1
         )[:, 0]  # (S,) pool block holding each slot's write position
         off = positions % bs
-        ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
-        cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
-        view_k = self._paged_view(ck, block_tables)  # (S, L, H, hd)
-        view_v = self._paged_view(cv, block_tables)
+        ck = _kv_scatter(cache["k"], blk, off, k)
+        cv = _kv_scatter(cache["v"], blk, off, v)
+        view_k = self._paged_view(ck, block_tables, q.dtype)  # (S, L, H, hd)
+        view_v = self._paged_view(cv, block_tables, q.dtype)
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, view_k,
             preferred_element_type=jnp.float32,
@@ -327,6 +379,61 @@ class MultiHeadAttention(Layer):
         )
         attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
         ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, view_v).reshape(s, 1,
+                                                                  h * hd)
+        out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
+        if self.use_bias:
+            out = out + params["bo"].astype(out.dtype)
+        return out, {"k": ck, "v": cv}
+
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        """Speculative-verification attention: x (S, K, D) holds, per
+        slot, K CANDIDATE tokens occupying consecutive absolute positions
+        [positions[s], positions[s] + K). All K are scored in ONE
+        fixed-shape dispatch — the K-wide generalization of paged_decode
+        (K=1 degenerates to it): each candidate's K/V row is scattered at
+        its own position and its scores are masked causally to
+        positions <= its own, so column j's logits equal what K=1 decode
+        would produce after accepting candidates 0..j-1. Rejected
+        candidates leave stale rows behind; the engine masks them (every
+        later read attends only below its own position, and the rows are
+        overwritten before ever becoming visible). Non-speculating slots
+        ride the trash block exactly as in decode."""
+        if not self.causal:
+            raise NotImplementedError(
+                "incremental decode requires causal attention "
+                "(MultiHeadAttention(causal=True)); bidirectional models "
+                "have no autoregressive decode"
+            )
+        dt = resolve_dtype(self.dtype)
+        if dt is not None:
+            x = x.astype(dt)
+        s, kw, _ = x.shape
+        h = self.num_heads
+        hd = shape_of(params["wq"])[1] // h
+        bs = _kv_block_size(cache["k"])
+        q = self._proj(params, x, "wq", "bq").reshape(s, kw, h, hd)
+        k = self._proj(params, x, "wk", "bk").reshape(s, kw, h, hd)
+        v = self._proj(params, x, "wv", "bv").reshape(s, kw, h, hd)
+        abs_pos = positions[:, None] + jnp.arange(kw)[None]  # (S, K)
+        blk = jnp.take_along_axis(block_tables, abs_pos // bs, axis=1)
+        off = abs_pos % bs  # (S, K)
+        ck = _kv_scatter(cache["k"], blk, off, k)
+        cv = _kv_scatter(cache["v"], blk, off, v)
+        view_k = self._paged_view(ck, block_tables, q.dtype)  # (S, L, H, hd)
+        view_v = self._paged_view(cv, block_tables, q.dtype)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, view_k,
+            preferred_element_type=jnp.float32,
+        ) / jnp.sqrt(jnp.float32(hd))  # (S, H, K, L)
+        visible = (
+            jnp.arange(view_k.shape[1])[None, None, :] <= abs_pos[:, :, None]
+        )  # (S, K, L): candidate j attends through its own position
+        scores = jnp.where(
+            visible[:, None, :, :], scores, jnp.float32(-1e30)
+        )
+        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, view_v).reshape(s, kw,
                                                                   h * hd)
         out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
@@ -353,17 +460,17 @@ class MultiHeadAttention(Layer):
         c = x.shape[1]
         h = self.num_heads
         hd = shape_of(params["wq"])[1] // h
-        bs = cache["k"].shape[1]
+        bs = _kv_block_size(cache["k"])
         q = self._proj(params, x, "wq", "bq").reshape(1, c, h, hd)
         k = self._proj(params, x, "wk", "bk").reshape(c, h, hd)
         v = self._proj(params, x, "wv", "bv").reshape(c, h, hd)
         abs_pos = start + jnp.arange(c)  # (C,)
         blk = block_table[abs_pos // bs]  # (C,)
         off = abs_pos % bs
-        ck = cache["k"].at[blk, off].set(k.astype(cache["k"].dtype))
-        cv = cache["v"].at[blk, off].set(v.astype(cache["v"].dtype))
-        view_k = self._paged_view(ck, block_table[None])[0]  # (L, H, hd)
-        view_v = self._paged_view(cv, block_table[None])[0]
+        ck = _kv_scatter(cache["k"], blk, off, k)
+        cv = _kv_scatter(cache["v"], blk, off, v)
+        view_k = self._paged_view(ck, block_table[None], q.dtype)[0]
+        view_v = self._paged_view(cv, block_table[None], q.dtype)[0]
         scores = jnp.einsum(
             "bqhd,khd->bhqk", q, view_k,
             preferred_element_type=jnp.float32,
@@ -465,6 +572,16 @@ class PositionalEmbedding(Layer):
             maybe_dequantize(params["table"]), positions, axis=0
         )  # (S, D)
         return x + rows[:, None].astype(x.dtype), cache
+
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        # Slot s's K candidates sit at positions[s] + 0..K-1.
+        kw = x.shape[1]
+        abs_pos = positions[:, None] + jnp.arange(kw)[None]  # (S, K)
+        rows = jnp.take(
+            maybe_dequantize(params["table"]), abs_pos, axis=0
+        )  # (S, K, D)
+        return x + rows.astype(x.dtype), cache
 
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
         c = x.shape[1]

@@ -111,6 +111,23 @@ class Layer:
         out, _ = self.decode(params, state, {}, x, pos=positions)
         return out, cache
 
+    def paged_verify(self, params: Params, state: State, cache, x, *,
+                     block_tables, positions):
+        """Speculative verification for a batch of SLOTS: x is
+        (S, K, ...) — K draft-proposed candidate tokens per slot at
+        consecutive absolute positions [positions[s], positions[s]+K) —
+        scored in one fixed-shape dispatch (K=1 is exactly paged_decode).
+        Default: position-independent layers apply tokenwise (the K
+        candidates are just more positions); position-dependent layers
+        (attention, positional embeddings) override."""
+        if not self.decode_safe:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support incremental "
+                "decode (generation)"
+            )
+        out, _ = self.apply(params, state, x, train=False)
+        return out, cache
+
     def paged_prefill(self, params: Params, state: State, cache, x, *,
                       block_table, start):
         """Prompt-chunk prefill for ONE sequence: x is (1, C, ...) covering
@@ -341,6 +358,22 @@ class Sequential(Layer):
                 new_cache[layer.name] = c
         return x, new_cache
 
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        new_cache = dict(cache)
+        for layer in self.layers:
+            x, c = layer.paged_verify(
+                params.get(layer.name, {}),
+                state.get(layer.name, {}),
+                cache.get(layer.name, {}),
+                x,
+                block_tables=block_tables,
+                positions=positions,
+            )
+            if c:
+                new_cache[layer.name] = c
+        return x, new_cache
+
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
         new_cache = dict(cache)
         for layer in self.layers:
@@ -528,6 +561,28 @@ class Residual(Layer):
             new_cache["main"] = cm
         if self.shortcut is not None:
             sc, cs = self.shortcut.paged_decode(
+                params.get("shortcut", {}), state.get("shortcut", {}),
+                cache.get("shortcut", {}), x,
+                block_tables=block_tables, positions=positions,
+            )
+            if cs:
+                new_cache["shortcut"] = cs
+        else:
+            sc = x
+        return self.activation(y + sc), new_cache
+
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        y, cm = self.main.paged_verify(
+            params.get("main", {}), state.get("main", {}),
+            cache.get("main", {}), x,
+            block_tables=block_tables, positions=positions,
+        )
+        new_cache = dict(cache)
+        if cm:
+            new_cache["main"] = cm
+        if self.shortcut is not None:
+            sc, cs = self.shortcut.paged_verify(
                 params.get("shortcut", {}), state.get("shortcut", {}),
                 cache.get("shortcut", {}), x,
                 block_tables=block_tables, positions=positions,

@@ -98,6 +98,13 @@ class Remat(Layer):
             block_tables=block_tables, positions=positions,
         )
 
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        return self.inner.paged_verify(
+            params, state, cache, x,
+            block_tables=block_tables, positions=positions,
+        )
+
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
         return self.inner.paged_prefill(
             params, state, cache, x, block_table=block_table, start=start,

@@ -36,11 +36,14 @@ Usage — quantize-on-load for serving::
 
 Quantized models SERVE (generate / predict / evaluate / serving.Engine);
 ``fit`` raises — int8 weights carry no gradients, and training belongs to
-the f32 masters the checkpoint still holds. The KV cache keeps the
-``Model.decode_dtype()`` policy dtype (f32/bf16): per-channel weight
-scales are static, but KV values are data-dependent per step, so an int8
-KV cache needs per-block dynamic scales — left as future work behind the
-same seam (docs/PERF.md).
+the f32 masters the checkpoint still holds. The KV cache defaults to the
+``Model.decode_dtype()`` policy dtype (f32/bf16); KV values are
+data-dependent per step, so the int8 KV cache uses per-row DYNAMIC
+scales — ``serving.Engine(kv_dtype="int8")`` stores the pools as the
+same ``{"q", "scale"}`` plain-dict leaves used here, quantizing on
+scatter and dequantizing in-trace on gather (``nn/attention.py``
+``_kv_scatter`` / ``_paged_view``; docs/SERVING.md, docs/PERF.md
+"Memory economy").
 
 Accuracy contract: dequantized weights differ from the originals by at
 most ``scale/2`` per element (symmetric round-to-nearest), and tests +

@@ -19,10 +19,19 @@ Greedy decode (``temperature=0``) is token-identical per request to
 served weights without a restart (the ``rl.PostTrainer`` sync seam —
 docs/RL.md). ``bench.py serve`` measures the throughput/latency win
 over the static-batch baseline (docs/SERVING.md).
+
+Memory-economy levers (docs/SERVING.md "Prefix caching & speculative
+decoding"): ``Engine(prefix_cache=True)`` shares common prompt prefixes
+across requests through a refcounted, copy-on-write block store;
+``kv_dtype="int8"`` quantizes the KV pools behind the ``decode_dtype``
+seam (more concurrent slots, fidelity-gated); ``draft_model=`` enables
+speculative decoding — k candidate tokens verified in one fixed-shape
+dispatch, token-exact against vanilla decode under greedy and pinned
+seeds. ``bench.py prefix`` measures all three.
 """
 
 from .engine import Engine
-from .kv_cache import BlockAllocator, PagedKVCache
+from .kv_cache import BlockAllocator, PagedKVCache, PrefixStore
 from .scheduler import Request, Scheduler, Sequence
 
 __all__ = [
@@ -32,4 +41,5 @@ __all__ = [
     "Sequence",
     "BlockAllocator",
     "PagedKVCache",
+    "PrefixStore",
 ]

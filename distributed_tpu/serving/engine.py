@@ -58,6 +58,8 @@ import numpy as np
 from ..obs import registry as obs_registry
 from ..obs import spans as obs_spans
 from ..training.model import Model, _cast_for_compute
+from ..utils import event_schema as evs
+from ..utils import events as events_lib
 from ..utils.profiler import StepTimer
 from .kv_cache import PagedKVCache
 from .scheduler import Request, Scheduler
@@ -159,6 +161,69 @@ def _decode_dispatch(module, temperature, top_k, policy, dtype_hints,
     return sampled, logp, caches
 
 
+def _verify_dispatch(module, temperature, top_k, policy, dtype_hints,
+                     params, state, caches, tokens, block_tables, positions,
+                     keys):
+    """One speculative VERIFY step over every slot: tokens (S, K) — per
+    slot, its real last token followed by K-1 draft proposals — scored by
+    the target model in one fixed-shape dispatch (``paged_verify``).
+    Column j's sampled token is exactly what K=1 decode would have
+    produced after accepting columns < j, and ``keys`` (S, K, 2) carries
+    the per-GENERATED-TOKEN-INDEX sampling keys (PR 12 derivation), so
+    accepted sampled tokens are bit-identical to the vanilla stream. The
+    host-side acceptance walk decides how many columns commit; slots not
+    speculating ride all-trash tables exactly as in decode."""
+    params = _cast_for_compute(policy, params, dtype_hints)
+    logits, caches = module.paged_verify(
+        params, state, caches, tokens,
+        block_tables=block_tables, positions=positions,
+    )
+    s, kw, v = logits.shape
+    sampled, logp = _sample_with_logprob(
+        logits.reshape(s * kw, v), keys.reshape(s * kw, 2),
+        temperature, top_k,
+    )
+    return sampled.reshape(s, kw), logp.reshape(s, kw), caches
+
+
+class _PairedKV:
+    """Target + draft paged caches moving in lockstep through the
+    scheduler seams (admit/reserve/release) so a speculating engine's two
+    pools can never drift: a slot holds blocks in BOTH or NEITHER.
+
+    The draft pool reserves first (it is fully provisioned, so in
+    practice it never fails) and the target second; on a target-side
+    admission failure the draft's adoption is rolled back. A draft
+    over-reservation left by a failed target ``reserve`` is harmless —
+    the blocks are already table-mapped for the slot and are consumed by
+    the retry or dropped by the release that follows preemption."""
+
+    def __init__(self, target: PagedKVCache, draft: PagedKVCache):
+        self.target = target
+        self.draft = draft
+
+    def blocks_for(self, tokens: int) -> int:
+        return self.target.blocks_for(tokens)
+
+    def admit(self, slot: int, tokens):
+        if not self.draft.reserve(slot, len(tokens)):
+            return None
+        cached = self.target.admit(slot, tokens)
+        if cached is None:
+            self.draft.release(slot)
+            return None
+        return cached
+
+    def reserve(self, slot: int, upto_len: int) -> bool:
+        if not self.draft.reserve(slot, upto_len):
+            return False
+        return self.target.reserve(slot, upto_len)
+
+    def release(self, slot: int) -> None:
+        self.target.release(slot)
+        self.draft.release(slot)
+
+
 class Engine:
     """Synchronous continuous-batching serving loop for a built token LM.
 
@@ -178,13 +243,36 @@ class Engine:
     configuration whose outputs are token-identical to per-request
     ``generate()``), ``top_k`` truncation otherwise; ``eos_id`` stops a
     sequence early when sampled.
+
+    Memory-economy levers (docs/SERVING.md "Prefix caching & speculative
+    decoding"), each off by default and token-exact when on:
+
+    ``prefix_cache=True``: content-addressed sharing of full prompt
+    blocks across requests — N requests with a common leading span store
+    and prefill it once (refcounted blocks, copy-on-write on divergence,
+    refcount-aware LRU eviction under pool pressure).
+    ``kv_dtype="int8"``: quantized KV pools (~4x fewer bytes than f32,
+    so ~4x the concurrent slots per HBM byte) with per-(position, head)
+    dynamic scales; fidelity-gated rather than bit-exact — see the
+    int8-KV contract in docs/SERVING.md.
+    ``draft_model`` + ``spec_k``: speculative decoding — the draft
+    proposes ``spec_k - 1`` greedy tokens per slot and the target scores
+    all ``spec_k`` candidates in ONE fixed-shape verify dispatch,
+    committing the longest agreeing run (1..spec_k tokens per dispatch;
+    token-exact, greedy or sampled, because verification samples each
+    position with the same per-token-index key vanilla decode would
+    use). The draft must be a built LM over the same vocabulary; it
+    keeps its own fully-provisioned paged cache and re-prefills fully on
+    (re-)admission.
     """
 
     def __init__(self, model: Model, max_slots: int, block_size: int, *,
                  max_len: int = 512, num_blocks: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 prefix_cache: bool = False, kv_dtype=None,
+                 draft_model: Optional[Model] = None, spec_k: int = 4):
         if not model.built:
             raise RuntimeError("Model not built")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -226,8 +314,43 @@ class Engine:
             model.module, model.params,
             max_slots=self.max_slots, block_size=self.block_size,
             max_blocks_per_seq=nb_per_seq, num_blocks=int(num_blocks),
-            dtype=model.decode_dtype(),
+            dtype=kv_dtype if kv_dtype is not None else model.decode_dtype(),
+            prefix_cache=bool(prefix_cache),
         )
+        # Speculative decoding: the draft LM gets its own (fully
+        # provisioned — it is small, and a draft-side admission stall
+        # would serve nothing) paged cache and greedy-pinned dispatches;
+        # the target gains a K-wide verify dispatch. self._kvs is the
+        # cache handle the scheduler seams use: the paired wrapper keeps
+        # both pools' slot ownership in lockstep, and degenerates to the
+        # target cache when no draft is configured.
+        self._draft = draft_model
+        self._spec_k = int(spec_k)
+        if draft_model is not None:
+            if not draft_model.built:
+                raise RuntimeError("draft model not built")
+            if self._spec_k < 2:
+                raise ValueError(
+                    f"spec_k must be >= 2 (k=1 is plain decode), got "
+                    f"{spec_k}"
+                )
+            jax.eval_shape(
+                lambda p: draft_model.module.init_cache(
+                    p, 1, self.max_len, jnp.float32
+                ),
+                draft_model.params,
+            )
+            self._draft_kv = PagedKVCache(
+                draft_model.module, draft_model.params,
+                max_slots=self.max_slots, block_size=self.block_size,
+                max_blocks_per_seq=nb_per_seq,
+                num_blocks=self.max_slots * nb_per_seq + 1,
+                dtype=draft_model.decode_dtype(),
+            )
+            self._kvs = _PairedKV(self.kv, self._draft_kv)
+        else:
+            self._draft_kv = None
+            self._kvs = self.kv
         # Both dispatches jit once (decode shapes are fixed; prefill
         # retraces only per distinct bucketed chunk length) under the
         # model's strategy/precision scopes — same discipline as every
@@ -250,6 +373,41 @@ class Engine:
         )
         self._prefill_fn = self.model._scoped(self._prefill_jit)
         self._decode_fn = self.model._scoped(self._decode_jit)
+        if draft_model is not None:
+            # Target-side verify: K candidates per slot, one dispatch.
+            self._verify_jit = jax.jit(
+                functools.partial(
+                    _verify_dispatch, model.module, self.temperature,
+                    self.top_k, model.precision, model._dtype_hints,
+                ),
+                donate_argnums=(2,),
+            )
+            self._verify_fn = self.model._scoped(self._verify_jit)
+            # Draft dispatches are GREEDY regardless of the engine's
+            # sampling config: proposals are only hints — acceptance
+            # compares them against the target's (possibly sampled)
+            # tokens — and a deterministic draft maximizes the agreement
+            # run without touching the output distribution.
+            self._draft_prefill_jit = jax.jit(
+                functools.partial(
+                    _prefill_dispatch, draft_model.module, 0.0, None,
+                    draft_model.precision, draft_model._dtype_hints,
+                ),
+                donate_argnums=(2,),
+            )
+            self._draft_decode_jit = jax.jit(
+                functools.partial(
+                    _decode_dispatch, draft_model.module, 0.0, None,
+                    draft_model.precision, draft_model._dtype_hints,
+                ),
+                donate_argnums=(2,),
+            )
+            self._draft_prefill_fn = draft_model._scoped(
+                self._draft_prefill_jit
+            )
+            self._draft_decode_fn = draft_model._scoped(
+                self._draft_decode_jit
+            )
         self.last_run_telemetry = None
         self._sched: Optional[Scheduler] = None  # live during run()
 
@@ -336,6 +494,17 @@ class Engine:
         jax.block_until_ready(placed)
         self._params = placed
         self._weights_version += 1
+        # The staleness contract extends to the prefix store: cached
+        # blocks were computed under the OLD weights, and while in-flight
+        # sequences deliberately keep theirs (the per-token version rows
+        # record the boundary), a NEW request must not silently seed from
+        # a one-version-old prefix — flush the store's references; live
+        # sharers keep their copies alive. A configured draft model is
+        # NOT swapped here: a stale draft only lowers the acceptance rate
+        # (its proposals are verified by the new target either way),
+        # never correctness — sync it out-of-band when drift hurts.
+        if self.kv.prefix is not None:
+            self.kv.prefix.flush(self.kv.allocator)
         return self._weights_version
 
     # ------------------------------------------------------------- helpers
@@ -349,11 +518,15 @@ class Engine:
         return min(max(64, -(-c // 64) * 64), self.max_len - start)
 
     def _prefill_chunks(self, seq):
-        """(start, length) chunks covering seq's current context."""
+        """(start, length) chunks covering seq's current context — minus
+        the leading span admission found already cached (prefix-store
+        adoption caps ``cached_len`` at context-1, so the final chunk —
+        whose logits sample the continuation — always exists)."""
         total = seq.context_len
-        step = self.prefill_chunk or total
+        begin = min(seq.cached_len, total - 1)
+        step = self.prefill_chunk or (total - begin)
         return [
-            (s, min(step, total - s)) for s in range(0, total, step)
+            (s, min(step, total - s)) for s in range(begin, total, step)
         ]
 
     # ---------------------------------------------------------------- run
@@ -380,13 +553,25 @@ class Engine:
             r if isinstance(r, Request) else Request(r[0], r[1])
             for r in requests
         ]
+        # Speculating engines need spec_k - 1 positions of table headroom
+        # past the last committed token: the verify dispatch scatters K
+        # consecutive candidate rows unconditionally, and clamping them
+        # would corrupt live positions.
+        cap = self.max_len - (
+            self._spec_k - 1 if self._draft is not None else 0
+        )
         for r in reqs:
             need = r.prompt.size + r.max_new_tokens
-            if need > self.max_len:
+            if need > cap:
                 raise ValueError(
                     f"request {r.request_id}: prompt {r.prompt.size} + "
                     f"max_new_tokens {r.max_new_tokens} exceeds engine "
                     f"max_len {self.max_len}"
+                    + (
+                        f" minus speculative headroom spec_k-1="
+                        f"{self._spec_k - 1}"
+                        if self._draft is not None else ""
+                    )
                 )
         timer = StepTimer(warmup=0)
         obs_reg = obs_registry.default_registry()
@@ -408,6 +593,11 @@ class Engine:
         decode_steps = 0
         prefill_dispatches = 0
         preemptions = 0
+        prefix_hit_tokens = 0
+        spec_rounds = 0
+        spec_proposed = 0
+        spec_accepted = 0
+        spec_tokens = 0
         # (seq, chunk list, next chunk index): at most ONE chunk runs per
         # loop iteration, so running sequences keep decoding between a
         # long prompt's chunks instead of stalling behind all of them.
@@ -417,19 +607,27 @@ class Engine:
             return time.perf_counter() - t0
 
         def finish(seq):
-            sched.finish(seq, self.kv)
+            sched.finish(seq, self._kvs)
             seq.finished_at = elapsed()
             results[seq.request.request_id] = seq.output()
 
         while not (sched.idle and not prefill_jobs):
             # -- admit: fill every free slot the pool can back ------------
             while True:
-                seq = sched.next_admittable(self.kv)
+                seq = sched.next_admittable(self._kvs)
                 if seq is None:
                     break
                 timer.attribute("queue_wait", elapsed() - seq.enqueued_at)
                 if seq.admitted_at is None:
                     seq.admitted_at = elapsed()
+                if seq.cached_len > 0:
+                    prefix_hit_tokens += seq.cached_len
+                    events_lib.emit(
+                        evs.PREFIX_CACHE_HIT,
+                        request_id=int(seq.request.request_id),
+                        cached_tokens=int(seq.cached_len),
+                        blocks=seq.cached_len // self.block_size,
+                    )
                 prefill_jobs.append([seq, self._prefill_chunks(seq), 0])
             if not sched.running:
                 # Nothing running and nothing admittable: the queue head's
@@ -473,6 +671,41 @@ class Engine:
                         first = int(first)
                 if final_chunk:
                     prefill_jobs.pop(0)
+                    # The slot's prompt blocks are now fully written:
+                    # publish them for future admissions to adopt. Only
+                    # the PROMPT span — generated tokens (present in a
+                    # re-admitted preempted context) are private.
+                    self.kv.insert_prefix(
+                        seq.slot, seq.tokens[:seq.prompt_len]
+                    )
+                    if self._draft is not None:
+                        # Draft prefill of the FULL context (the draft
+                        # has no prefix store; its pool is cheap). Runs
+                        # chunk-bucketed like the target so long prompts
+                        # reuse the same compile buckets; the sampled
+                        # continuation is discarded — proposals start
+                        # from the target's real first token.
+                        for dstart in range(
+                            0, seq.context_len,
+                            self.prefill_chunk or seq.context_len,
+                        ):
+                            dc = min(
+                                self.prefill_chunk or seq.context_len,
+                                seq.context_len - dstart,
+                            )
+                            dcb = self._bucket(dc, dstart)
+                            dbuf = np.zeros((1, dcb), np.int32)
+                            dbuf[0, :dc] = seq.tokens[dstart:dstart + dc]
+                            _, _, self._draft_kv.caches = (
+                                self._draft_prefill_fn(
+                                    self._draft.params, self._draft.state,
+                                    self._draft_kv.caches, dbuf,
+                                    self._draft_kv.block_tables[seq.slot],
+                                    np.int32(dstart), np.int32(dc - 1),
+                                    _token_key(seq.sample_seed, 0),
+                                )
+                            )
+                        self._draft_kv.positions[seq.slot] = seq.context_len
                     self.kv.positions[seq.slot] = seq.context_len
                     seq.tokens.append(first)
                     seq.token_versions.append(self._weights_version)
@@ -495,11 +728,18 @@ class Engine:
             # position; under pool pressure evict the youngest runner
             # back to the queue (its generated tokens ride along and are
             # re-prefilled on re-admission).
+            # A speculating engine reserves spec_k - 1 extra positions:
+            # the verify dispatch scatters K candidate rows past the
+            # committed context, and those writes must land in real,
+            # owned blocks.
+            headroom = self._spec_k - 1 if self._draft is not None else 0
             for seq in ready:
                 if seq.slot is None:
                     continue  # evicted by an older peer this pass
-                while not self.kv.reserve(seq.slot, seq.context_len):
-                    victim = sched.preempt_youngest(self.kv, protect=seq)
+                while not self._kvs.reserve(
+                    seq.slot, seq.context_len + headroom
+                ):
+                    victim = sched.preempt_youngest(self._kvs, protect=seq)
                     if victim is None:
                         raise RuntimeError(
                             f"request {seq.request.request_id}: cannot "
@@ -516,6 +756,108 @@ class Engine:
                     ]
             ready = [s for s in ready if s.slot is not None]
             if not ready:
+                continue
+            if self._draft is not None:
+                # ---- speculative round: draft proposes, target verifies.
+                # Candidate matrix column 0 is each slot's REAL last
+                # token; columns 1..K-1 are the draft's greedy chain.
+                # One K-wide verify dispatch then scores all columns, and
+                # the host walk commits the longest run where the draft's
+                # next proposal agreed with the target's token — 1..K
+                # tokens per dispatch, bit-identical to vanilla decode.
+                kw = self._spec_k
+                ready_mask = np.zeros((self.max_slots,), bool)
+                cand = np.zeros((self.max_slots, kw), np.int32)
+                keys = np.zeros((self.max_slots, kw, 2), np.uint32)
+                for seq in ready:
+                    ready_mask[seq.slot] = True
+                    cand[seq.slot, 0] = seq.last_token
+                    for j in range(kw):
+                        keys[seq.slot, j] = _token_key(
+                            seq.sample_seed, seq.num_generated + j
+                        )
+                dtables = np.where(
+                    ready_mask[:, None], self._draft_kv.block_tables,
+                    np.int32(0),
+                )
+                dpos = np.where(
+                    ready_mask, self._draft_kv.positions, 0
+                ).astype(np.int32)
+                dummy_keys = np.zeros((self.max_slots, 2), np.uint32)
+                cur = cand[:, 0].copy()
+                with obs_spans.span("draft", timer=timer):
+                    for j in range(1, kw):
+                        prop, _, self._draft_kv.caches = (
+                            self._draft_decode_fn(
+                                self._draft.params, self._draft.state,
+                                self._draft_kv.caches, cur, dtables,
+                                dpos, dummy_keys,
+                            )
+                        )
+                        prop = np.asarray(jax.device_get(prop))
+                        cand[:, j] = prop
+                        cur = prop.astype(np.int32)
+                        # Non-speculating slots advance through the trash
+                        # block (positions 1..K-2 of table row 0).
+                        dpos = (dpos + 1).astype(np.int32)
+                tables = np.where(
+                    ready_mask[:, None], self.kv.block_tables, np.int32(0)
+                )
+                positions = np.where(
+                    ready_mask, self.kv.positions, 0
+                ).astype(np.int32)
+                with obs_spans.span("decode", timer=timer) as sp_dec:
+                    toks, logps, self.kv.caches = self._verify_fn(
+                        self._params, self._state, self.kv.caches, cand,
+                        tables, positions, keys,
+                    )
+                    toks, logps = jax.device_get((toks, logps))
+                    toks = np.asarray(toks)
+                decode_steps += 1
+                spec_rounds += 1
+                spec_proposed += (kw - 1) * len(ready)
+                util = self.kv.utilization()
+                util_samples.append(util)
+                queue_samples.append(len(sched.waiting))
+                free_blocks_min = min(
+                    free_blocks_min, self.kv.allocator.num_free
+                )
+                obs_reg.gauge("engine/kv_utilization", float(util))
+                obs_reg.gauge("engine/queue_depth", len(sched.waiting))
+                obs_reg.ring_append("engine/step_seconds", {
+                    "step": int(decode_steps),
+                    "seconds": round(sp_dec.seconds, 6),
+                    "running": len(ready),
+                })
+                for seq in ready:
+                    m = 0
+                    while True:
+                        tok = int(toks[seq.slot, m])
+                        seq.tokens.append(tok)
+                        seq.token_versions.append(self._weights_version)
+                        if return_logprobs:
+                            seq.logprobs.append(float(logps[seq.slot, m]))
+                        seq.num_generated += 1
+                        m += 1
+                        if seq.finished or tok == self.eos_id:
+                            break
+                        # Accept the next column only if the draft's
+                        # proposal there IS the token the target just
+                        # produced — then column m's logits were
+                        # conditioned on the true prefix.
+                        if m >= kw or int(cand[seq.slot, m]) != tok:
+                            break
+                    spec_tokens += m
+                    spec_accepted += m - 1
+                    # Invariant: positions = committed rows = next write.
+                    self.kv.positions[seq.slot] = seq.context_len - 1
+                    self._draft_kv.positions[seq.slot] = (
+                        seq.context_len - 1
+                    )
+                    if seq.finished or seq.last_token == self.eos_id:
+                        finish(seq)
+                if on_decode_step is not None:
+                    on_decode_step(self, decode_steps)
                 continue
             tokens = np.zeros((self.max_slots,), np.int32)
             ready_mask = np.zeros((self.max_slots,), bool)
@@ -638,6 +980,45 @@ class Engine:
         report["decode_steps"] = decode_steps
         report["prefill_dispatches"] = prefill_dispatches
         report["preemptions"] = preemptions
+        if self.kv.prefix is not None:
+            st = self.kv.prefix
+            lookups = st.hits + st.misses
+            hit_rate = st.hits / lookups if lookups else 0.0
+            # Bytes the pool did NOT have to hold/recompute because
+            # admissions adopted already-cached blocks.
+            bytes_saved = st.hits * self.kv.bytes_per_block()
+            report["prefix_cache"] = {
+                "hit_rate": round(hit_rate, 4),
+                "hit_blocks": int(st.hits),
+                "hit_tokens": int(prefix_hit_tokens),
+                "insertions": int(st.insertions),
+                "evictions": int(st.evictions),
+                "cow_copies": int(self.kv.cow_copies),
+                "kv_bytes_saved": int(bytes_saved),
+            }
+            obs_reg.gauge("engine/prefix_hit_rate", round(hit_rate, 4))
+            obs_reg.gauge("engine/kv_bytes_saved", int(bytes_saved))
+        if self._draft is not None:
+            accept_rate = (
+                spec_accepted / spec_proposed if spec_proposed else 0.0
+            )
+            tpd = spec_tokens / spec_rounds if spec_rounds else 0.0
+            report["speculative"] = {
+                "k": int(self._spec_k),
+                "rounds": int(spec_rounds),
+                "proposed": int(spec_proposed),
+                "accepted": int(spec_accepted),
+                "accept_rate": round(accept_rate, 4),
+                "tokens_per_dispatch": round(tpd, 3),
+            }
+            obs_reg.gauge("engine/spec_accept_rate", round(accept_rate, 4))
+            # One per-run aggregate (the transport fsyncs per record).
+            events_lib.emit(
+                evs.SPEC_VERIFY, rounds=int(spec_rounds),
+                proposed=int(spec_proposed), accepted=int(spec_accepted),
+                accept_rate=round(accept_rate, 4),
+                tokens_per_dispatch=round(tpd, 3),
+            )
         obs_reg.counter("engine/generated_tokens", report["generated_tokens"])
         obs_reg.counter("engine/requests", len(reqs))
         obs_reg.counter("engine/preemptions", preemptions)

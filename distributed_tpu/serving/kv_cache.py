@@ -18,20 +18,55 @@ unallocated block-table entry (and every inactive slot's whole table) at
 it, so the fixed-shape decode dispatch can scatter unconditionally —
 writes from dead slots land in block 0 and no live sequence ever reads
 it.
+
+**Prefix sharing (``prefix_cache=True``).** Blocks are REFCOUNTED: N
+sequences whose prompts share a leading span at block granularity map
+their tables at the SAME pool blocks instead of recomputing and storing
+the span N times. The :class:`PrefixStore` is the content-addressed
+index — full prompt blocks are keyed by a token-content hash CHAIN
+(``_chain_hashes``: block i's key digests block i-1's key plus block i's
+tokens, so a key names the entire prefix up to and including its block,
+never just the block's own tokens). Admission (:meth:`PagedKVCache.admit`)
+walks the chain, adopts every leading hit (``incref``), and tells the
+engine how many positions are already cached — prefill then runs only
+the non-cached suffix. The store itself holds one reference per cached
+block, so finished sequences can release (``decref``) while their prompt
+blocks stay warm for the next request; under pool pressure, eviction is
+refcount-aware LRU — only blocks whose SOLE owner is the store (refcount
+1) are reclaimable, blocks any live sequence shares are pinned.
+Divergence inside a shared block (a sequence must write a position a
+peer still reads) is COPY-ON-WRITE: the block is duplicated into a
+private block before the first private scatter (:meth:`_copy_block`).
+
+The fleet reuses the same chain keys: a KV handoff payload carries them
+next to its ``<leaf-path>@<logical-start>@<shape>`` block keys
+(``fleet.handoff``), so a decode replica whose store already holds a
+prefix receives only the suffix blocks.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..utils import event_schema as evs
+from ..utils import events as events_lib
+
 
 class BlockAllocator:
-    """Free-list over the pool's allocatable blocks (1..num_blocks-1;
-    block 0 is the trash block). Allocation is all-or-nothing and LIFO
-    (recently freed blocks are reused first — friendliest to any
-    allocator-backed backend), frees are idempotent-checked."""
+    """Refcounted free-list over the pool's allocatable blocks
+    (1..num_blocks-1; block 0 is the trash block). Allocation is
+    all-or-nothing and LIFO (recently freed blocks are reused first —
+    friendliest to any allocator-backed backend); a fresh allocation has
+    refcount 1, prefix sharing grows it (``incref``), and a block returns
+    to the free list only when the LAST reference drops (``decref``).
+    ``free`` is the loud path: it raises on double-free AND on freeing a
+    block some other owner still references — callers that may hold a
+    shared block (scheduler preemption, sequence finish) must ``decref``
+    instead."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -41,7 +76,7 @@ class BlockAllocator:
             )
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
-        self._allocated = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def num_free(self) -> int:
@@ -52,24 +87,198 @@ class BlockAllocator:
         return self.num_blocks - 1
 
     def allocate(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None when the pool cannot serve all of them
-        (all-or-nothing: a partial grant would deadlock admission)."""
+        """``n`` block ids (each at refcount 1), or None when the pool
+        cannot serve all of them (all-or-nothing: a partial grant would
+        deadlock admission)."""
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         return blocks
 
-    def free(self, blocks) -> None:
+    def refcount(self, block: int) -> int:
+        """Current reference count (0 for free/never-allocated blocks)."""
+        return self._refs.get(int(block), 0)
+
+    def incref(self, blocks) -> None:
+        """Add one reference to each allocated block (prefix adoption, or
+        the store registering a block). Raises on free blocks — a
+        reference to an unowned block would alias the free list."""
         for b in blocks:
-            if b not in self._allocated:
+            if b not in self._refs:
+                raise ValueError(
+                    f"incref of unallocated block {b} (free blocks cannot "
+                    "be shared)"
+                )
+            self._refs[b] += 1
+
+    def decref(self, blocks) -> int:
+        """Drop one reference from each block, returning blocks whose
+        count hit zero to the free list. Raises loudly on blocks with no
+        outstanding reference (the double-free class). Returns how many
+        blocks were actually freed."""
+        freed = 0
+        for b in blocks:
+            if b not in self._refs:
                 raise ValueError(f"double free of block {b}")
-            self._allocated.discard(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+                freed += 1
+        return freed
+
+    def free(self, blocks) -> None:
+        """Release EXCLUSIVELY-owned blocks. Raises on double-free (block
+        not allocated) and on blocks with refcount > 1 — freeing a block
+        a peer sequence or the prefix store still references would hand
+        its storage to the next allocation while live readers attend over
+        it. Shared owners must ``decref``."""
+        for b in blocks:
+            if b not in self._refs:
+                raise ValueError(f"double free of block {b}")
+            if self._refs[b] > 1:
+                raise ValueError(
+                    f"free of shared block {b} (refcount "
+                    f"{self._refs[b]}) — holders of possibly-shared "
+                    "blocks must decref, not free"
+                )
+        self.decref(blocks)
 
     def utilization(self) -> float:
         """Fraction of allocatable pool blocks currently owned."""
-        return len(self._allocated) / max(self.num_allocatable, 1)
+        return len(self._refs) / max(self.num_allocatable, 1)
+
+
+def _chain_hashes(tokens, block_size: int) -> List[str]:
+    """Content key per FULL block of ``tokens``: key i digests key i-1
+    plus block i's tokens, so a single key names the whole prefix through
+    its block (two prompts share key i iff their first (i+1) blocks are
+    token-identical). Partial trailing blocks get no key — only immutable,
+    fully-written blocks are shareable."""
+    n = len(tokens) // int(block_size)
+    keys: List[str] = []
+    prev = b"dtpu-prefix/%d" % int(block_size)
+    for i in range(n):
+        span = np.asarray(
+            tokens[i * block_size:(i + 1) * block_size], np.int32
+        )
+        h = hashlib.blake2b(prev + span.tobytes(), digest_size=16)
+        prev = h.digest()
+        keys.append(h.hexdigest())
+    return keys
+
+
+class PrefixStore:
+    """Content-addressed index of cached full prompt blocks: chain hash
+    (:func:`_chain_hashes`) -> pool block id, in LRU order. The store
+    holds ONE allocator reference per entry (taken by the owner on
+    ``insert``), which is what keeps a finished request's prompt blocks
+    warm; :meth:`evict` reclaims LRU entries whose refcount is exactly 1
+    (store-only — nothing live shares them) when the allocator runs dry.
+    Pure bookkeeping: device copies and refcounts live with the caller
+    (:class:`PagedKVCache`)."""
+
+    def __init__(self):
+        self._entries: "OrderedDict[str, int]" = OrderedDict()
+        self.hits = 0          # blocks adopted by admissions
+        self.misses = 0        # blocks admissions had to compute
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    @property
+    def blocks(self) -> List[int]:
+        return list(self._entries.values())
+
+    def lookup(self, keys: List[str]) -> List[int]:
+        """Block ids for the LEADING run of ``keys`` present in the store
+        (chain keys make any hit's predecessors hits too, so the walk
+        stops at the first miss). Hits refresh LRU order; hit/miss
+        counters tally at block granularity."""
+        found: List[int] = []
+        for k in keys:
+            if k not in self._entries:
+                break
+            self._entries.move_to_end(k)
+            found.append(self._entries[k])
+        self.hits += len(found)
+        self.misses += len(keys) - len(found)
+        return found
+
+    def insert(self, key: str, block: int) -> bool:
+        """Register ``block`` under ``key`` (False if the key is already
+        cached — the existing entry wins and is LRU-refreshed; the caller
+        must NOT transfer a reference in that case)."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        self._entries[key] = int(block)
+        self.insertions += 1
+        return True
+
+    def evict(self, allocator: BlockAllocator, need: int) -> int:
+        """Drop LRU entries whose block only the store references
+        (refcount 1) until ``need`` blocks came free or no entry is
+        evictable; blocks shared with a live sequence are pinned. Returns
+        the number of blocks freed."""
+        freed = 0
+        if need <= 0:
+            return 0
+        for key in list(self._entries):
+            block = self._entries[key]
+            if allocator.refcount(block) != 1:
+                continue  # a live sequence shares it: pinned
+            del self._entries[key]
+            freed += allocator.decref([block])
+            self.evictions += 1
+            if freed >= need:
+                break
+        return freed
+
+    def flush(self, allocator: BlockAllocator) -> int:
+        """Drop EVERY entry (weight swaps: cached KV computed under old
+        weights must not seed new requests). Blocks shared with live
+        sequences lose only the store's reference — the sequences keep
+        decoding over their (now-private) copies."""
+        dropped = len(self._entries)
+        for block in self._entries.values():
+            allocator.decref([block])
+        self._entries.clear()
+        self.evictions += dropped
+        return dropped
+
+
+def _map_pools(fn, tree):
+    """Map ``fn`` over the leaf arrays of a paged-cache tree. Local
+    traversal instead of jax.tree_util so this module's import graph
+    stays numpy-only (the arrays themselves are jnp; ``.at[]`` needs no
+    import)."""
+    if isinstance(tree, dict):
+        return {k: _map_pools(fn, v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_map_pools(fn, v) for v in tree)
+    return fn(tree)
+
+
+def _pool_leaves(tree, out=None):
+    if out is None:
+        out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _pool_leaves(tree[k], out)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            _pool_leaves(v, out)
+    else:
+        out.append(tree)
+    return out
 
 
 class PagedKVCache:
@@ -79,13 +288,19 @@ class PagedKVCache:
     ``caches`` holds the module's per-layer pools
     (``module.init_paged_cache``: K/V of shape
     ``(num_blocks, block_size, H, hd)`` per attention layer, dtype from
-    the model's precision policy via ``Model.decode_dtype()``).
-    ``block_tables`` is the host-side (max_slots, max_blocks_per_seq)
-    int32 map the engine ships with every decode dispatch; unassigned
-    entries stay 0 (the trash block)."""
+    the model's precision policy via ``Model.decode_dtype()`` — or, with
+    ``dtype="int8"``, quantized ``{"q","scale"}`` pool pairs in
+    ``quant.py``'s plain-dict idiom). ``block_tables`` is the host-side
+    (max_slots, max_blocks_per_seq) int32 map the engine ships with every
+    decode dispatch; unassigned entries stay 0 (the trash block).
+
+    ``prefix_cache=True`` attaches a :class:`PrefixStore` and switches
+    admission to :meth:`admit` (adopt cached prompt blocks, reserve only
+    the rest); see the module docstring for the sharing semantics."""
 
     def __init__(self, module, params, *, max_slots: int, block_size: int,
-                 max_blocks_per_seq: int, num_blocks: int, dtype):
+                 max_blocks_per_seq: int, num_blocks: int, dtype,
+                 prefix_cache: bool = False):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         self.block_size = int(block_size)
@@ -101,10 +316,28 @@ class PagedKVCache:
         )
         self.positions = np.zeros((self.max_slots,), np.int32)
         self._slot_blocks: List[List[int]] = [[] for _ in range(max_slots)]
+        self.prefix: Optional[PrefixStore] = (
+            PrefixStore() if prefix_cache else None
+        )
+        self.cow_copies = 0
 
     def blocks_for(self, tokens: int) -> int:
         """Blocks needed to hold ``tokens`` positions."""
         return -(-int(tokens) // self.block_size)
+
+    def _allocate(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, reclaiming store-only (refcount-1)
+        prefix entries in LRU order when the free list alone cannot
+        serve the request."""
+        grant = self.allocator.allocate(n)
+        if grant is None and self.prefix is not None:
+            evicted = self.prefix.evict(
+                self.allocator, n - self.allocator.num_free
+            )
+            if evicted:
+                events_lib.emit(evs.PREFIX_EVICT, blocks=evicted)
+            grant = self.allocator.allocate(n)
+        return grant
 
     def reserve(self, slot: int, upto_len: int) -> bool:
         """Grow ``slot``'s table so positions < ``upto_len`` are backed by
@@ -120,7 +353,7 @@ class PagedKVCache:
         have = len(self._slot_blocks[slot])
         if need <= have:
             return True
-        grant = self.allocator.allocate(need - have)
+        grant = self._allocate(need - have)
         if grant is None:
             return False
         for i, b in enumerate(grant):
@@ -128,15 +361,109 @@ class PagedKVCache:
         self._slot_blocks[slot].extend(grant)
         return True
 
+    def admit(self, slot: int, tokens) -> Optional[int]:
+        """Back ``slot`` for the full context ``tokens``, adopting any
+        cached prefix. Returns the number of leading positions already
+        cached (0 without a prefix store or on a store miss) — the engine
+        prefills only [cached, len(tokens)) — or None when the pool
+        cannot back the context (nothing is held on failure).
+
+        The cached span is capped at ``len(tokens) - 1``: the engine
+        always recomputes at least the LAST context position, because the
+        next token is sampled from its logits and a fully-cached context
+        would otherwise have nothing to dispatch. When that cap lands the
+        first recomputed position INSIDE an adopted shared block (a fully
+        cached prompt ending on a block boundary), the block is
+        copied-on-write here — before the first private scatter — so the
+        recompute never corrupts the peers still reading the shared
+        copy."""
+        n = len(tokens)
+        if self.prefix is None:
+            return 0 if self.reserve(slot, n) else None
+        if self._slot_blocks[slot]:
+            raise ValueError(
+                f"admit on slot {slot} which already owns "
+                f"{len(self._slot_blocks[slot])} blocks — release first"
+            )
+        shared = self.prefix.lookup(_chain_hashes(tokens, self.block_size))
+        cached = min(len(shared) * self.block_size, n - 1)
+        self.allocator.incref(shared)
+        for i, b in enumerate(shared):
+            self.block_tables[slot, i] = b
+        self._slot_blocks[slot].extend(shared)
+        if not self.reserve(slot, n):
+            self.release(slot)  # drop the adoptions: all-or-nothing
+            return None
+        div = cached // self.block_size
+        if div < len(shared) and self.allocator.refcount(
+            self._slot_blocks[slot][div]
+        ) > 1:
+            if not self._copy_block(slot, div):
+                self.release(slot)
+                return None
+        return cached
+
+    def _copy_block(self, slot: int, index: int) -> bool:
+        """Copy-on-write: duplicate ``slot``'s table entry ``index`` into
+        a fresh private block (device copy across every layer pool) and
+        drop the shared reference. False when no block is available."""
+        grant = self._allocate(1)
+        if grant is None:
+            return False
+        new = grant[0]
+        old = self._slot_blocks[slot][index]
+        self.caches = _map_pools(
+            lambda pool: pool.at[new].set(pool[old]), self.caches
+        )
+        self._slot_blocks[slot][index] = new
+        self.block_tables[slot, index] = new
+        self.allocator.decref([old])
+        self.cow_copies += 1
+        return True
+
+    def insert_prefix(self, slot: int, tokens) -> int:
+        """Register ``slot``'s now-written full blocks covering
+        ``tokens`` (the request's PROMPT — generated tokens are private
+        by construction) in the prefix store, one store reference each.
+        Chain keys already present are skipped (first writer wins; the
+        adopted/CoW'd copies hold identical rows). Returns how many
+        blocks were newly cached."""
+        if self.prefix is None:
+            return 0
+        added = 0
+        keys = _chain_hashes(tokens, self.block_size)
+        blocks = self._slot_blocks[slot]
+        for i, key in enumerate(keys[:len(blocks)]):
+            if self.prefix.insert(key, blocks[i]):
+                self.allocator.incref([blocks[i]])
+                added += 1
+        return added
+
     def release(self, slot: int) -> None:
-        """Free every block ``slot`` owns and point its table back at the
-        trash block (so an inactive slot's scatter writes stay harmless)."""
+        """Drop ``slot``'s reference on every block it maps (freeing the
+        exclusively-owned ones) and point its table back at the trash
+        block (so an inactive slot's scatter writes stay harmless).
+        Shared blocks — prefix-store entries, blocks peers adopted —
+        survive with their remaining references; this is why preemption
+        and finish route here instead of ``allocator.free``."""
         blocks = self._slot_blocks[slot]
         if blocks:
-            self.allocator.free(blocks)
+            self.allocator.decref(blocks)
         self._slot_blocks[slot] = []
         self.block_tables[slot, :] = 0
         self.positions[slot] = 0
+
+    def bytes_per_block(self) -> int:
+        """Device bytes one pool block occupies across every layer leaf
+        (quantized pools count q + scale) — the int8-KV capacity-ratio
+        denominator."""
+        total = 0
+        for leaf in _pool_leaves(self.caches):
+            per = leaf.dtype.itemsize
+            for d in leaf.shape[1:]:
+                per *= int(d)
+            total += per
+        return int(total)
 
     def utilization(self) -> float:
         return self.allocator.utilization()
@@ -146,4 +473,6 @@ class PagedKVCache:
         return sum(len(b) for b in self._slot_blocks)
 
 
-__all__ = ["BlockAllocator", "PagedKVCache"]
+__all__ = [
+    "BlockAllocator", "PagedKVCache", "PrefixStore", "_chain_hashes",
+]

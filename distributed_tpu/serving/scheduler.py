@@ -94,6 +94,11 @@ class Sequence:
         self.logprobs: List[float] = []
         self.token_versions: List[int] = []
         self.sample_seed: int = 0  # mixed (engine, request) seed; set by run()
+        # Leading positions already resident in the paged cache when the
+        # slot was admitted (prefix-store adoption, or a fleet KV
+        # handoff): prefill starts here instead of 0. Reset on every
+        # admission — a preempted sequence re-negotiates its cached span.
+        self.cached_len: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -163,13 +168,26 @@ class Scheduler:
         """Admit the queue head if a slot is free and the pool can back
         its whole current context (prompt, plus any tokens generated
         before a preemption); None otherwise. FIFO head-of-line: skipping
-        ahead would starve big-context requests forever."""
+        ahead would starve big-context requests forever.
+
+        When the cache exposes prefix-sharing admission (``kv.admit``),
+        it is used instead of a plain reservation: cached leading blocks
+        are adopted and ``seq.cached_len`` records how many positions the
+        engine may skip in prefill."""
         if not self.waiting or not self._free_slots:
             return None
         seq = self.waiting[0]
         slot = self._free_slots[-1]
-        if not kv.reserve(slot, seq.context_len):
-            return None
+        admit = getattr(kv, "admit", None)
+        if admit is not None:
+            cached = admit(slot, seq.tokens)
+            if cached is None:
+                return None
+            seq.cached_len = int(cached)
+        else:
+            if not kv.reserve(slot, seq.context_len):
+                return None
+            seq.cached_len = 0
         self.waiting.popleft()
         self._free_slots.pop()
         seq.slot = slot
@@ -179,7 +197,12 @@ class Scheduler:
     def preempt_youngest(self, kv, protect: Sequence) -> Optional[Sequence]:
         """Evict the most recently admitted running sequence (other than
         ``protect``, the one that needs the block) back to the FRONT of
-        the queue, releasing its blocks. None when no victim exists."""
+        the queue, releasing its blocks. None when no victim exists.
+
+        Release goes through ``kv.release`` (a DECREF per block), never
+        ``allocator.free``: a preempted sequence may hold prefix-store or
+        peer-shared blocks (refcount > 1), and freeing those would hand
+        storage still being read to the next allocation."""
         for seq in reversed(self.running):
             if seq is not protect:
                 self.running.remove(seq)

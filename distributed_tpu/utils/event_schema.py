@@ -67,6 +67,11 @@ METRICS_SNAPSHOT = "metrics_snapshot"
 AUTO_SHARD_PLAN = "auto_shard_plan"
 FLEET_REPLICA_KILLED = "fleet_replica_killed"
 
+# Serving memory economy (serving/kv_cache.py, serving/engine.py).
+PREFIX_CACHE_HIT = "prefix_cache_hit"
+PREFIX_EVICT = "prefix_evict"
+SPEC_VERIFY = "spec_verify"
+
 
 # -------------------------------------------------------------- schema
 # required: keys every emit site must pass literally (consumers index
@@ -190,6 +195,20 @@ EVENTS: Dict[str, dict] = {
         "required": ("replica",),
         "optional": ("requeued",),
     },
+    PREFIX_CACHE_HIT: {
+        "required": ("request_id", "cached_tokens"),
+        "optional": ("blocks", "cow"),
+    },
+    PREFIX_EVICT: {
+        "required": ("blocks",),
+        "optional": ("reason",),
+    },
+    # Per-RUN aggregate (the emit transport fsyncs per record, so the
+    # hot verify loop must not emit per dispatch).
+    SPEC_VERIFY: {
+        "required": ("rounds", "proposed", "accepted"),
+        "optional": ("accept_rate", "tokens_per_dispatch"),
+    },
 }
 
 
@@ -216,4 +235,5 @@ __all__ = [
     "POST_RESTORE_STEP", "FIRST_STEP", "SYNC_CHECK_FAILED",
     "BUDDY_REFRESH", "BUDDY_REFRESH_FAILED", "FLIGHT_DUMP",
     "METRICS_SNAPSHOT", "AUTO_SHARD_PLAN", "FLEET_REPLICA_KILLED",
+    "PREFIX_CACHE_HIT", "PREFIX_EVICT", "SPEC_VERIFY",
 ]

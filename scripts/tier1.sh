@@ -167,6 +167,18 @@ if [ -n "${TIER1_OBS_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_PREFIX_SMOKE=1: same idea for the serving memory-economy stack —
+# runs the prefix-cache / int8-KV / speculative-decode tests plus the
+# bench prefix smoke (~45 s) so kv_cache/engine/handoff changes iterate
+# fast. The full gated measurement runs via `python bench.py prefix`
+# (BENCH_prefix.json). NOT a tier-1 substitute.
+if [ -n "${TIER1_PREFIX_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_prefix.py \
+        "tests/test_bench.py::test_bench_prefix_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

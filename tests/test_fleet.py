@@ -213,8 +213,13 @@ def test_handoff_pack_install_roundtrip_across_pools(lm):
     bad.block_size = 8
     with pytest.raises(HandoffIncompatible, match="block_size"):
         install_kv(dst, 0, bad)
+    # Dtype is gated per LEAF (an int8 pool mixes int8 q with f32 scale
+    # leaves, so no single payload dtype string can stand for all of
+    # them): a shipped run whose data dtype disagrees with its
+    # destination leaf refuses to install.
     bad2 = pack_kv(src, 0, 10)
-    bad2.dtype = "bfloat16"
+    key = next(iter(bad2.blocks))
+    bad2.blocks[key] = bad2.blocks[key].astype(np.float64)
     with pytest.raises(HandoffIncompatible, match="dtype"):
         install_kv(dst, 0, bad2)
 

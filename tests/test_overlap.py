@@ -402,6 +402,11 @@ OVERLAP_WORKER = """
     """
 
 
+# @slow (tier-1 budget, PR 16): ~11s subprocess e2e; the supervised
+# kill-restart-resume path stays in tier-1 via test_resilience.py's
+# parity acceptance, and prefetch/async resume correctness is covered
+# by the in-process resume tests above.
+@pytest.mark.slow
 def test_supervisor_kill_restart_resume_with_overlap(tmp_path):
     """ACCEPTANCE (end to end): a supervised worker running fit with
     prefetch depth 2 + async checkpoints is fault-killed mid-run; the

@@ -57,6 +57,10 @@ def test_injected_syntax_error_is_caught(tmp_path):
         r_lang.parse(broken, "model.R")
 
 
+# @slow (tier-1 budget, PR 16): ~8s full local.R run to hit the typo;
+# the parse-time error path stays in-tier above, and the runtime R
+# execution path stays in-tier via test_local_example_executes_and_trains.
+@pytest.mark.slow
 def test_injected_body_typo_fails_at_runtime(tmp_path):
     """A *syntactically valid* typo inside an R body (misspelled callee)
     parses fine but must fail when the body executes."""

@@ -71,6 +71,11 @@ def test_version_check(rb):
     assert v.values == [distributed_tpu.__version__]
 
 
+# @slow (tier-1 budget, PR 16): ~9s full train through reticulate; the R
+# local train flow stays in tier-1 via test_r_execution.py's
+# test_local_example_executes_and_trains, and the readme marshaling
+# pieces via test_evaluate_and_predict_marshaling below.
+@pytest.mark.slow
 def test_local_flow_reference_readme_45_76(rb):
     """The reference's local R trainer, through R marshaling end to end."""
     d = rb.dataset_mnist()  # normalize=TRUE folds in the /255 of README.md:56

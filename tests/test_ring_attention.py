@@ -35,7 +35,14 @@ def _qkv(b=2, t=16, h=2, d=8, seed=0):
 
 
 class TestRingAttentionOp:
-    @pytest.mark.parametrize("causal", [False, True])
+    # causal=True @slow (tier-1 budget, PR 16): the causal ring-vs-dense
+    # parity stays in tier-1 via test_zigzag_matches_naive_and_dense
+    # (causal, both schedules, width 8); the non-causal variant has no
+    # other in-tier coverage and stays.
+    @pytest.mark.parametrize("causal", [
+        False,
+        pytest.param(True, marks=pytest.mark.slow),
+    ])
     def test_matches_dense(self, devices, causal):
         mesh = dtpu.make_mesh({"seq": 8}, devices=devices)
         q, k, v = _qkv()
