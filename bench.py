@@ -2279,7 +2279,7 @@ def bench_fleet(num_requests=64, replica_counts=(1, 2, 4), max_slots=4,
                 block_size=16, vocab=512, num_layers=4, d_model=256,
                 num_heads=8, max_len=128, prompt_range=(8, 32),
                 new_range=(32, 96), burst_size=16, burst_gap_s=0.15,
-                kill_replicas=2, kill_at_step=8, seed=0):
+                kill_replicas=2, kill_at_step=8, seed=0, strict=True):
     """Disaggregated serving fleet (``python bench.py fleet``, artifact
     BENCH_fleet.json; docs/SERVING.md "Fleet"). Three pinned facts:
 
@@ -2377,11 +2377,17 @@ def bench_fleet(num_requests=64, replica_counts=(1, 2, 4), max_slots=4,
             "decode_steps": t["decode_steps"],
             "preemptions": t["preemptions"],
         })
-    for prev, cur in zip(rows, rows[1:]):
-        assert cur["tokens_per_sec"] > prev["tokens_per_sec"], (
-            f"aggregate tokens/s must increase with decode replicas: "
-            f"{[r['tokens_per_sec'] for r in rows]}"
-        )
+    # ``strict=False`` (the smoke, mirroring bench_prefix) drops only
+    # this scaling gate: the virtual timelines are built from MEASURED
+    # per-dispatch costs, so on a loaded 1-core box a tiny-shape R=2 row
+    # can time slower than R=1 by noise alone. Every mechanism gate
+    # (zero lost, token-exact kill recovery) still asserts.
+    if strict:
+        for prev, cur in zip(rows, rows[1:]):
+            assert cur["tokens_per_sec"] > prev["tokens_per_sec"], (
+                f"aggregate tokens/s must increase with decode replicas: "
+                f"{[r['tokens_per_sec'] for r in rows]}"
+            )
     base = rows[0]["tokens_per_sec"]
     for row in rows:
         row["speedup_vs_r1"] = round(row["tokens_per_sec"] / base, 2)
@@ -2437,6 +2443,304 @@ def bench_fleet(num_requests=64, replica_counts=(1, 2, 4), max_slots=4,
         "clock": "virtual: per-replica timelines over real dispatch "
                  "walls (single-host harness; docs/SERVING.md 'Fleet')",
         "spinup_alloc_s": kt["decode_pool"]["spinup_alloc_s"],
+        "workload": {
+            "max_slots": max_slots,
+            "block_size": block_size,
+            "prompt_range": list(prompt_range),
+            "new_range": list(new_range),
+            "model": f"lm_l{num_layers}_d{d_model}_v{vocab}",
+        },
+    }
+
+
+# ---------------------------------------------------------------- service --
+def bench_service(num_requests=18, replica_counts=(1, 2, 4), max_slots=2,
+                  block_size=4, vocab=64, num_layers=2, d_model=32,
+                  num_heads=2, max_len=64, build_len=64,
+                  prompt_range=(4, 10), new_range=(8, 16), burst_size=6,
+                  burst_gap_s=1.0, kill_replicas=2, kill_after_tokens=8,
+                  flood_requests=8, paying_requests=4, quota_rate=2.0,
+                  quota_burst=40.0, ttft_bound_s=30.0, deadline_s=240.0,
+                  seed=0, sections=("scaling", "kill", "quota")):
+    """The serving fleet as REAL processes on WALL time (``python
+    bench.py fleet --clock wall``, artifact BENCH_service.json;
+    docs/SERVING.md "Running as a service"). This is the measured
+    answer to BENCH_fleet.json's virtual-clock caveat: every number
+    here is wall-clock across worker processes spawned with
+    ``python -m distributed_tpu.serve_service.worker``. Four pinned
+    facts:
+
+    1. **Scaling** — wall tokens/s and TTFT p50/p99 at R decode
+       processes under the same bursty open-loop arrivals, KV handoff
+       riding /dev/shm. The strictly-increasing gate is HONEST about
+       the host: R CPU-bound decode processes only speed up wall time
+       when the box has >= R cores, so on smaller hosts the gate
+       degrades to the mechanism facts (every replica decodes, zero
+       lost, token-exact) and the artifact records which gate ran —
+       the PERF.md measured-mechanism precedent.
+    2. **Streaming byte-identity** — every output is assembled from
+       the per-decode-step token frames a client would stream, and is
+       asserted byte-identical to the non-streaming in-process
+       ``Engine.run`` of the same requests (``Model.build`` is
+       seed-deterministic, so worker processes hold identical params).
+    3. **Kill-a-replica** — a decode WORKER PROCESS is killed
+       mid-decode (after ``kill_after_tokens`` streamed tokens). Gate:
+       zero lost requests, outputs token-exact, a respawned process
+       absorbs the requeue, and the dead worker leaves a readable
+       flight-recorder postmortem referenced from the event log
+       (rendered by ``dtpu-events``).
+    4. **Quotas** — a flooding tenant behind a token bucket cannot
+       starve the weight-2 paying tenant: the flood is rejected at
+       the front door (reason ``"quota"``) while every paying request
+       finishes with TTFT p99 under ``ttft_bound_s``.
+
+    ``sections`` picks which rows run: the scaling rows (and their
+    streaming byte-identity gate) always do; ``"kill"`` and ``"quota"``
+    each spawn another worker fleet (~3 s spin-up per process), so the
+    tier-1 schema smoke runs scaling only — kill recovery and quota
+    starvation are separately pinned by the @slow multi-process matrix
+    in tests/test_serve_service.py, and the checked-in
+    BENCH_service.json carries every section.
+    """
+    import os
+    import tempfile
+
+    from distributed_tpu.fleet import Router
+    from distributed_tpu.obs.cli import summarize
+    from distributed_tpu.serve_service import (
+        ServeService, ServeSpec, TenantQuotas,
+    )
+    from distributed_tpu.serving import Engine, Request
+    from distributed_tpu.utils.events import read_events
+
+    model_cfg = dict(vocab_size=vocab, num_layers=num_layers,
+                     d_model=d_model, num_heads=num_heads, max_len=max_len)
+    spec = ServeSpec(model=model_cfg, build_len=build_len,
+                     max_slots=max_slots, block_size=block_size,
+                     max_len=max_len)
+
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(0, vocab, (int(n),)).astype(np.int32)
+        for n in rng.integers(prompt_range[0], prompt_range[1] + 1,
+                              num_requests)
+    ]
+    news = [int(m) for m in rng.integers(new_range[0], new_range[1] + 1,
+                                         num_requests)]
+    useful_tokens = int(sum(news))
+    arrivals = [(i // burst_size) * burst_gap_s
+                for i in range(num_requests)]
+
+    def requests():
+        return [Request(p, m, seed=0) for p, m in zip(prompts, news)]
+
+    # Non-streaming reference IN THIS process: the byte-identity bar
+    # every service output (assembled from streamed token frames) must
+    # clear. Same params as the workers — Model.build is
+    # seed-deterministic.
+    model = dtpu.Model(dtpu.models.transformer_lm(**model_cfg))
+    model.compile(optimizer=spec.optimizer, loss=spec.loss)
+    model.build((build_len,))
+    reference = [np.asarray(o) for o in Engine(
+        model, max_slots=max_slots, block_size=block_size, max_len=max_len
+    ).run(requests())]
+    del model
+
+    def token_exact(outs):
+        return all(o is not None and np.array_equal(r, o)
+                   for r, o in zip(reference, outs))
+
+    # ------------------------------------------------------- scaling rows
+    rows = []
+    checked = 0
+    for r in replica_counts:
+        svc = ServeService(spec, decode_replicas=int(r),
+                           prefill_replicas=1, transport="shm")
+        with svc:
+            res = svc.run(requests(), arrival_times=arrivals,
+                          deadline_s=deadline_s)
+            stats = svc.collect_stats()
+        t = res.telemetry
+        assert t["lost_requests"] == 0, t["lost_requests"]
+        assert token_exact(res), (
+            f"R={r}: streamed outputs diverged from Engine.run"
+        )
+        checked += num_requests
+        decode = sorted((s for s in stats.values()
+                         if s.get("role") == "decode"),
+                        key=lambda s: s["pid"])
+        rows.append({
+            "decode_replicas": int(r),
+            "prefill_replicas": 1,
+            "tokens_per_sec": t["tokens_per_sec"],
+            "wall_s": t["wall_s"],
+            "ttft_p50_s": t["time_to_first_token"]["p50_s"],
+            "ttft_p99_s": t["time_to_first_token"]["p99_s"],
+            "queue_depth_peak": t["queue_depth_peak"],
+            "spinup_s": t["decode_pool"]["spinup_s"],
+            "handoffs_installed": sum(s["handoffs_installed"]
+                                      for s in decode),
+            "handoffs_fallback": sum(s["handoffs_fallback"]
+                                     for s in decode),
+            "decode_steps_per_replica": [s["decode_steps"]
+                                         for s in decode],
+            "streamed_token_exact": True,
+        })
+
+    cores = os.cpu_count() or 1
+    strict_scaling = cores >= max(replica_counts)
+    if strict_scaling:
+        for prev, cur in zip(rows, rows[1:]):
+            assert cur["tokens_per_sec"] > prev["tokens_per_sec"], (
+                f"wall tokens/s must increase with decode processes on a "
+                f"{cores}-core host: "
+                f"{[row['tokens_per_sec'] for row in rows]}"
+            )
+        scaling_gate = (f"strict: wall tokens/s strictly increasing "
+                        f"across R={list(replica_counts)} ({cores} cores)")
+    else:
+        top = rows[-1]
+        assert all(s > 0 for s in top["decode_steps_per_replica"]), (
+            f"every decode process must do real work: "
+            f"{top['decode_steps_per_replica']}"
+        )
+        scaling_gate = (
+            f"mechanism-only: this {cores}-core host time-slices R "
+            f"CPU-bound decode processes, so wall tokens/s cannot scale "
+            f"with R; asserted instead: every replica decodes real work, "
+            f"zero lost requests, outputs token-exact (the PERF.md "
+            f"measured-mechanism precedent). Re-run on an >= "
+            f"{max(replica_counts)}-core host for the strict gate."
+        )
+    base = rows[0]["tokens_per_sec"]
+    for row in rows:
+        row["speedup_vs_r1"] = round(row["tokens_per_sec"] / base, 2)
+
+    # ---------------------------------------------------------- kill row
+    kill_row = None
+    if "kill" in sections:
+        tmp = tempfile.mkdtemp(prefix="dtpu-bench-service-")
+        prev_log = os.environ.get("DTPU_EVENT_LOG")
+        os.environ["DTPU_EVENT_LOG"] = os.path.join(tmp, "events.jsonl")
+        try:
+            svc = ServeService(spec, decode_replicas=int(kill_replicas),
+                               prefill_replicas=1, transport="shm")
+            killed = []
+            victim = f"decode-{int(kill_replicas) - 1}"
+
+            def chaos(s):
+                if not killed and s.streamed_tokens >= kill_after_tokens:
+                    s.kill_replica(victim)
+                    killed.append(victim)
+
+            with svc:
+                kres = svc.run(requests(), arrival_times=arrivals,
+                               deadline_s=deadline_s, on_pump=chaos)
+            kt = kres.telemetry
+            assert killed and kt["decode_pool"]["kills"] == 1
+            assert kt["lost_requests"] == 0, kt["lost_requests"]
+            assert token_exact(kres), (
+                "kill-recovery outputs diverged from Engine.run"
+            )
+            checked += num_requests
+            initial_spawns = int(kill_replicas) + 1  # decode pool + prefill
+            respawned = kt["decode_pool"]["spawns"] > initial_spawns
+            assert respawned, "the service must respawn killed capacity"
+            post = summarize(read_events(os.environ["DTPU_EVENT_LOG"]))
+            dumps = [d for d in post["flight_dumps"]
+                     if d["readable"] and d["reason"] == "replica_kill"]
+            assert dumps, (
+                "a killed worker must leave a readable flight-recorder "
+                "postmortem referenced from the event log"
+            )
+            kill_row = {
+                "decode_replicas": int(kill_replicas),
+                "killed_replica": killed[0],
+                "killed_after_streamed_tokens": kill_after_tokens,
+                "lost_requests": kt["lost_requests"],
+                "token_exact_vs_engine_run": True,
+                "respawned": bool(respawned),
+                "requeues": kt["router"]["requeues"],
+                "tokens_per_sec": kt["tokens_per_sec"],
+                "ttft_p99_s": kt["time_to_first_token"]["p99_s"],
+                "postmortem": {
+                    "flight_dump": dumps[0]["path"],
+                    "records": len(dumps[0]["records"]),
+                    "renderer": "dtpu-events " + os.environ["DTPU_EVENT_LOG"],
+                },
+            }
+        finally:
+            if prev_log is None:
+                del os.environ["DTPU_EVENT_LOG"]
+            else:
+                os.environ["DTPU_EVENT_LOG"] = prev_log
+
+    # --------------------------------------------------------- quota row
+    quota_row = None
+    if "quota" in sections:
+        fprompts = [rng.integers(0, vocab, (8,)).astype(np.int32)
+                    for _ in range(flood_requests + paying_requests)]
+        fnews = [12] * len(fprompts)
+        freqs = [Request(p, m, seed=0) for p, m in zip(fprompts, fnews)]
+        tenants = (["flood"] * flood_requests
+                   + ["paying"] * paying_requests)
+        farrivals = ([0.0] * flood_requests
+                     + [0.5 * i for i in range(paying_requests)])
+        svc = ServeService(
+            spec, decode_replicas=1, transport="none",
+            router=Router(tenant_weights={"paying": 2.0}),
+            quotas=TenantQuotas({"flood": (quota_rate, quota_burst)}),
+        )
+        with svc:
+            qres = svc.run(freqs, arrival_times=farrivals, tenants=tenants,
+                           deadline_s=deadline_s)
+        qt = qres.telemetry
+        paying = qt["tenants"].get("paying", {"finished": 0})
+        assert qt["quotas"]["rejected"] > 0, "the flood must hit the bucket"
+        assert paying["finished"] == paying_requests, (
+            f"every paying request must finish: {paying}"
+        )
+        assert paying["ttft_p99_s"] <= ttft_bound_s, (
+            f"paying-tenant p99 TTFT {paying['ttft_p99_s']}s exceeds the "
+            f"{ttft_bound_s}s bound behind a flooding tenant"
+        )
+        quota_row = {
+            "flood_requests": flood_requests,
+            "flood_rejected": qt["quotas"]["rejected_by_tenant"]["flood"],
+            "flood_limit": {"rate_tokens_per_s": quota_rate,
+                            "burst_tokens": quota_burst},
+            "paying_requests": paying_requests,
+            "paying_finished": paying["finished"],
+            "paying_weight": 2.0,
+            "paying_ttft_p50_s": paying["ttft_p50_s"],
+            "paying_ttft_p99_s": paying["ttft_p99_s"],
+            "ttft_bound_s": ttft_bound_s,
+            "lost_requests": qt["lost_requests"],
+        }
+
+    top = rows[-1]
+    return {
+        "metric":
+            f"service_wall_tokens_per_sec_r{top['decode_replicas']}",
+        "value": top["tokens_per_sec"],
+        "unit": "tokens/s",
+        "clock": "wall",
+        "scaling": rows,
+        "scaling_gate": scaling_gate,
+        "kill": kill_row,
+        "quota": quota_row,
+        "streaming": {
+            "byte_identical_to_engine_run": True,
+            "requests_checked": checked,
+        },
+        "transport": "shm",
+        "arrivals": {
+            "process": "bursty open-loop",
+            "num_requests": num_requests,
+            "burst_size": burst_size,
+            "burst_gap_s": burst_gap_s,
+            "useful_tokens": useful_tokens,
+        },
         "workload": {
             "max_slots": max_slots,
             "block_size": block_size,
@@ -2986,7 +3290,7 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
              "cifar", "resnet50", "lm", "longctx", "resilience", "zero",
              "precision", "compile_cache", "serve", "elastic", "quant",
              "fused_update", "autoshard", "fleet", "rl", "recovery", "obs",
-             "prefix"}
+             "prefix", "service"}
     unknown = set(modes) - known
     if unknown or not modes:
         raise SystemExit(
@@ -3044,6 +3348,13 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
         # kill-a-replica recovery row (BENCH_fleet.json;
         # docs/SERVING.md "Fleet").
         extra.append(bench_fleet())
+    if "service" in modes:
+        # Opt-in: the fleet as REAL worker processes on WALL time —
+        # shm KV transport, streaming byte-identity, process-kill
+        # recovery with postmortem, tenant quotas (BENCH_service.json;
+        # docs/SERVING.md "Running as a service"). Canonical spelling:
+        # `python bench.py fleet --clock wall`.
+        extra.append(bench_service())
     if "rl" in modes:
         # Opt-in: online post-training closed loop — rollout tokens/s,
         # train steps/s, weight-sync latency, reward improvement, and the
@@ -3105,6 +3416,22 @@ def main(modes=("mnist", "multistep", "overlap", "convergence", "cifar",
 
 
 if __name__ == "__main__":
-    main(tuple(sys.argv[1:])
+    argv = list(sys.argv[1:])
+    # `bench.py fleet --clock wall` is the canonical spelling of the
+    # real-process service mode (the fleet's virtual-clock caveat,
+    # measured away): rewrite it to the `service` mode name.
+    if "--clock" in argv:
+        i = argv.index("--clock")
+        clock = argv[i + 1] if i + 1 < len(argv) else None
+        if clock != "wall":
+            raise SystemExit(
+                f"--clock takes 'wall' (real processes, wall time), "
+                f"got {clock!r}; the fleet mode's virtual clock is the "
+                f"default"
+            )
+        del argv[i:i + 2]
+        argv = ["service" if m == "fleet" else m for m in argv] or [
+            "service"]
+    main(tuple(argv)
          or ("mnist", "multistep", "overlap", "convergence", "cifar",
              "resnet50", "lm"))

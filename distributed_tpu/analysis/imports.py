@@ -68,6 +68,14 @@ JAX_FREE_MODULES: Tuple[str, ...] = (
     "distributed_tpu.cluster.net",
     "distributed_tpu.launch.core",
     "distributed_tpu.serving.scheduler",
+    # serving service router side (the router process never pays a jax
+    # import; serve_service.worker is the ONE jax module and is spawned,
+    # never imported, by these)
+    "distributed_tpu.serve_service",
+    "distributed_tpu.serve_service.protocol",
+    "distributed_tpu.serve_service.quotas",
+    "distributed_tpu.serve_service.service",
+    "distributed_tpu.serve_service.transport",
     # the linter itself
     "distributed_tpu.analysis",
     "distributed_tpu.analysis.cli",

@@ -159,9 +159,7 @@ class Router:
             self.requeues += 1
 
     # ------------------------------------------------------------- dequeue
-    def next_request(self) -> Optional[Sequence]:
-        """Pop the waiting sequence with the smallest virtual finish time
-        (ties break on tenant name, so order is deterministic)."""
+    def _best_tenant(self) -> Optional[_Tenant]:
         best: Optional[_Tenant] = None
         for name in sorted(self._tenants):
             t = self._tenants[name]
@@ -169,6 +167,20 @@ class Router:
                 continue
             if best is None or t.queue[0].vft < best.queue[0].vft:
                 best = t
+        return best
+
+    def peek(self) -> Optional[Sequence]:
+        """The sequence ``next_request`` would pop, without popping — a
+        dispatcher can inspect the WFQ head (is it fresh? does capacity
+        exist for it?) and leave it queued, preserving WFQ order instead
+        of pop/requeue churn."""
+        best = self._best_tenant()
+        return best.queue[0] if best is not None else None
+
+    def next_request(self) -> Optional[Sequence]:
+        """Pop the waiting sequence with the smallest virtual finish time
+        (ties break on tenant name, so order is deterministic)."""
+        best = self._best_tenant()
         if best is None:
             return None
         seq = best.queue.popleft()
@@ -183,9 +195,11 @@ class Router:
         prefix store already holds the sequence's leading prompt block
         (``DecodeReplica.holds_prefix``) admits it with a warm cache and,
         under block transfer, receives a trimmed suffix-only payload —
-        then least in-flight, then name (deterministic tie-break). Without
-        prefix caching every replica scores equal affinity and this is
-        exactly the old least-loaded rule."""
+        affinity TIES break by lowest queue depth (the replica that will
+        ADMIT soonest; two warm replicas are equally warm, but the one
+        with the shorter wait wins), then least in-flight, then name
+        (deterministic). Without prefix caching every replica scores
+        equal affinity and this degrades to shortest-queue/least-loaded."""
         pool = list(candidates)
         if not pool:
             return None
@@ -193,7 +207,8 @@ class Router:
         def key(rep):
             holds = getattr(rep, "holds_prefix", None)
             affinity = 1 if holds is not None and holds(seq) else 0
-            return (-affinity, rep.in_flight, rep.name)
+            return (-affinity, getattr(rep, "queue_depth", rep.in_flight),
+                    rep.in_flight, rep.name)
 
         return min(pool, key=key)
 

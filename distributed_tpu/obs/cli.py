@@ -3,6 +3,7 @@
     dtpu-events run.events.jsonl
     dtpu-events run.events.jsonl --flight /tmp/flight-rank1-pid33.jsonl
     dtpu-events run.events.jsonl --json
+    dtpu-events run.events.jsonl --follow   # live tail for a running gang
 
 Reads a supervised run's JSONL event log (``utils.events``) and renders a
 human postmortem: the attempt timeline, injected faults, per-recovery
@@ -12,6 +13,13 @@ and the tail of every flight-recorder dump the run referenced
 before each death, not just the lifecycle facts. ``--json`` emits the
 same summary as one machine-readable object.
 
+``--follow`` tails a LIVE log instead: one rendered line per event as
+it lands, surviving the writer's rotate/truncate the same way
+``EventLog`` survives its reader's (stat the inode, reopen on change)
+and skipping a torn tail line until its newline arrives — watch a
+serving gang (``serve_service``) or a supervised training run without
+re-running the postmortem.
+
 jax-free: runs on any controller box against a copied log file.
 """
 
@@ -19,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -175,6 +184,72 @@ def render(summary: dict, *, tail: int = 10) -> str:
     return "\n".join(lines)
 
 
+def event_line(event: dict) -> str:
+    """One event as one follow-mode line: timestamp, kind, then the
+    payload keys in emit order (the transport's own ts/event/pid are
+    folded into the prefix)."""
+    body = {k: v for k, v in event.items()
+            if k not in ("ts", "event", "pid")}
+    fields = " ".join(f"{k}={v}" for k, v in body.items())
+    return (f"[{_fmt_ts(event.get('ts'))}] {event.get('event')}"
+            + (f" {fields}" if fields else ""))
+
+
+def follow(path, *, poll_s: float = 0.2, stop=None):
+    """Yield events appended to ``path`` as they land, forever (or until
+    ``stop()`` returns true — the test seam). The reader mirrors
+    ``EventLog``'s writer idiom from the other side: on EOF, stat the
+    path and reopen when the inode changed or the file shrank (rotation/
+    truncation), and hold back a torn tail line until its newline
+    arrives — a half-written record is pending, not corrupt. A path that
+    does not exist yet is waited for, so the tail can start before the
+    gang does."""
+    path = str(path)
+    f = None
+    ino = None
+    buf = ""
+    try:
+        while True:
+            if f is None:
+                try:
+                    f = open(path, "r")
+                    ino = os.fstat(f.fileno()).st_ino
+                    buf = ""
+                except FileNotFoundError:
+                    if stop is not None and stop():
+                        return
+                    time.sleep(poll_s)
+                    continue
+            chunk = f.read()
+            if chunk:
+                buf += chunk
+                while "\n" in buf:
+                    line, buf = buf.split("\n", 1)
+                    if not line.strip():
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn mid-rotation: skip, keep tailing
+                continue
+            try:
+                st = os.stat(path)
+                rotated = (st.st_ino != ino
+                           or st.st_size < f.tell() - len(buf))
+            except FileNotFoundError:
+                rotated = True
+            if rotated:
+                f.close()
+                f = None
+                continue
+            if stop is not None and stop():
+                return
+            time.sleep(poll_s)
+    finally:
+        if f is not None:
+            f.close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(prog="dtpu-events", description=__doc__)
     ap.add_argument("event_log", type=str,
@@ -190,7 +265,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead of "
                          "the human rendering")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail the log live (one line per event as it "
+                         "lands; waits for the file if it does not exist "
+                         "yet; ctrl-C to stop)")
     args = ap.parse_args(argv)
+    if args.follow:
+        try:
+            for event in follow(args.event_log):
+                print(json.dumps(event) if args.json
+                      else event_line(event), flush=True)
+        except KeyboardInterrupt:
+            pass
+        return 0
     if not Path(args.event_log).exists():
         print(f"dtpu-events: no such event log: {args.event_log}",
               file=sys.stderr)

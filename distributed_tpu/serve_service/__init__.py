@@ -1,0 +1,38 @@
+"""dtpu-serve: the disaggregated fleet as a real multi-process service.
+
+``fleet.ServingFleet`` proved the serving arithmetic — prefill/decode
+disaggregation, KV handoff, WFQ, autoscaling — inside one process on a
+virtual clock. This package runs the same machinery as PROCESSES on wall
+time:
+
+- :class:`~.service.ServeService` — router process: listener, admission
+  (quotas → bounded queue → SLO → WFQ), placement, streaming delivery,
+  death recovery, autoscaled spawn/drain of real workers.
+- ``serve_service.worker`` — the replica process entrypoint
+  (``python -m distributed_tpu.serve_service.worker``); the only module
+  here that imports jax, and deliberately NOT imported by this package.
+- :mod:`~.protocol` — length-prefixed socket framing (JSON header +
+  binary blobs) with torn-frame semantics.
+- :mod:`~.transport` — KV payloads as ``.npy`` blocks: /dev/shm
+  references same-host, framed blobs cross-host.
+- :mod:`~.quotas` — per-tenant token buckets in front of the queue.
+
+Everything importable from here is jax-free (dtpu-lint manifest): the
+router process never pays a jax import.
+"""
+
+from .protocol import MAGIC, ProtocolError, recv_exact, recv_frame, send_frame
+from .quotas import TenantQuotas, TokenBucket
+from .service import ServeService, ServeSpec, ServiceResult, TokenStream
+from .transport import (
+    ShmTransport, TransportError, decode_payload, encode_payload,
+    handoff_to_payload, payload_to_handoff, shm_root,
+)
+
+__all__ = [
+    "MAGIC", "ProtocolError", "recv_exact", "recv_frame", "send_frame",
+    "TenantQuotas", "TokenBucket",
+    "ServeService", "ServeSpec", "ServiceResult", "TokenStream",
+    "ShmTransport", "TransportError", "decode_payload", "encode_payload",
+    "handoff_to_payload", "payload_to_handoff", "shm_root",
+]

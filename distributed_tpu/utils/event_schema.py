@@ -72,6 +72,13 @@ PREFIX_CACHE_HIT = "prefix_cache_hit"
 PREFIX_EVICT = "prefix_evict"
 SPEC_VERIFY = "spec_verify"
 
+# Multi-process serving service (serve_service/).
+SERVICE_START = "service_start"
+REPLICA_SPAWN = "replica_spawn"
+STREAM_OPEN = "stream_open"
+QUOTA_REJECT = "quota_reject"
+TRANSPORT_FALLBACK = "transport_fallback"
+
 
 # -------------------------------------------------------------- schema
 # required: keys every emit site must pass literally (consumers index
@@ -209,6 +216,30 @@ EVENTS: Dict[str, dict] = {
         "required": ("rounds", "proposed", "accepted"),
         "optional": ("accept_rate", "tokens_per_dispatch"),
     },
+    SERVICE_START: {
+        "required": ("decode_replicas", "prefill_replicas"),
+        "optional": ("transport", "port"),
+    },
+    REPLICA_SPAWN: {
+        "required": ("replica",),
+        "optional": ("role", "pid", "port", "spinup_s"),
+    },
+    STREAM_OPEN: {
+        "required": ("request_id",),
+        "optional": ("tenant",),
+    },
+    # One per quota rejection — admission events are rare by definition
+    # (the bucket throttles the flood before it reaches the queue).
+    QUOTA_REJECT: {
+        "required": ("tenant",),
+        "optional": ("request_id", "retry_after_s"),
+    },
+    # A KV payload could not ride its transport (missing shm dir, torn
+    # frame, incompatible pool): the receiver re-prefills.
+    TRANSPORT_FALLBACK: {
+        "required": ("request_id",),
+        "optional": ("reason", "replica"),
+    },
 }
 
 
@@ -236,4 +267,6 @@ __all__ = [
     "BUDDY_REFRESH", "BUDDY_REFRESH_FAILED", "FLIGHT_DUMP",
     "METRICS_SNAPSHOT", "AUTO_SHARD_PLAN", "FLEET_REPLICA_KILLED",
     "PREFIX_CACHE_HIT", "PREFIX_EVICT", "SPEC_VERIFY",
+    "SERVICE_START", "REPLICA_SPAWN", "STREAM_OPEN", "QUOTA_REJECT",
+    "TRANSPORT_FALLBACK",
 ]

@@ -179,6 +179,23 @@ if [ -n "${TIER1_PREFIX_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_SERVICE_SMOKE=1: same idea for the multi-process serving
+# service — runs the framing/transport/quota units, the single-worker
+# real-process end-to-end, the router/fleet tests it builds on, and the
+# bench service schema smoke (~45 s; worker spin-up is ~3 s/process) so
+# serve_service changes iterate fast. The multi-process matrix (shm
+# handoff, kill-a-replica, pool mismatch, live autoscale) stays @slow
+# (run it with -m slow when touching worker/service paths; `python
+# bench.py fleet --clock wall` drives the measured BENCH_service.json).
+# NOT a tier-1 substitute.
+if [ -n "${TIER1_SERVICE_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_serve_service.py \
+        tests/test_fleet.py \
+        "tests/test_bench.py::test_bench_service_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

@@ -265,6 +265,9 @@ def test_raw_optax_transform_rejects_lr_mutation():
         m.set_learning_rate(0.01)
 
 
+# @slow (tier-1 budget, PR 17): ~8s (tensorboard import dominates);
+# every other callback and the hook-order contract stay in-tier.
+@pytest.mark.slow
 def test_tensorboard_callback_writes_events(tmp_path):
     from distributed_tpu.training.callbacks import TensorBoard
 

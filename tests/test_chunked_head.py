@@ -106,6 +106,10 @@ def test_head_chunks_validation():
         m2.fit(x, y, batch_size=8, epochs=1, verbose=0)
 
 
+# @slow (tier-1 budget, PR 17): ~10s interrupted-run drive;
+# chunked-vs-plain parity and chunked-under-DP stay in-tier, and the
+# resume math itself is pinned by the callback restore tests.
+@pytest.mark.slow
 def test_chunked_head_checkpoint_resume(tmp_path):
     """head_chunks composes with the resume math: a run interrupted after
     a checkpoint and restarted finishes bit-identical to an uninterrupted
@@ -141,6 +145,11 @@ def test_chunked_head_generate_unaffected():
     np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
 
 
+# @slow (tier-1 budget, PR 17): ~8s composition cross-product; chunked
+# head numerics and plain grad-accum composition stay in-tier, and the
+# K x chunks x accum x clip matrix is already @slow (PR 15 retag) in
+# test_multi_step.py — this is the same surface minus K.
+@pytest.mark.slow
 def test_chunked_head_composes_with_accumulation_and_clip():
     """head_chunks x gradient_accumulation_steps x grad_clip: the chunked
     loss feeds the same optax pipeline (MultiSteps wrapping clip), so the

@@ -84,6 +84,11 @@ class TestDataModelPipe:
         ):
             assert leaf.sharding.spec[0] == "pipe", leaf.sharding
 
+    # @slow (tier-1 budget, PR 17): ~7s three-axis composition; the deeper
+    # data x fsdp x pipe stack stays in-tier
+    # (test_data_fsdp_pipe_trains_and_matches_single_device) as does
+    # data x seq (TestDataSeq) — pairwise axis parity is covered there.
+    @pytest.mark.slow
     def test_matches_single_device_numerics(self, devices):
         """One train step under data x model x pipe equals the same step on
         one device (the invariant every strategy in the framework holds)."""
@@ -162,6 +167,9 @@ class TestFsdpModel:
 
 
 class TestDataSeq:
+    # @slow (tier-1 budget, PR 17): ~10s data x seq end-to-end; the
+    # op-level data_x_seq mesh test in test_ring_attention stays in-tier.
+    @pytest.mark.slow
     def test_equals_dataseqparallel(self, devices):
         """CompositeParallel({'data','seq'}) must reproduce DataSeqParallel
         (ring attention over the seq axis) exactly."""

@@ -126,6 +126,56 @@ def test_router_requeue_goes_to_head():
     assert r.next_request() is s2
 
 
+def test_router_place_breaks_affinity_ties_by_queue_depth():
+    """Prefix-affinity ties are NOT broken by candidate order: the
+    replica with the lowest queue depth wins (it will admit soonest),
+    then least in-flight, then name — fully deterministic."""
+
+    class Rep:
+        def __init__(self, name, queue_depth, in_flight, holds=False):
+            self.name = name
+            self.queue_depth = queue_depth
+            self.in_flight = in_flight
+            self._holds = holds
+            self.holds_prefix = lambda seq: self._holds
+
+    r = Router()
+    _, seq = r.submit(Request(np.array([1, 2], np.int32), 4), now=0.0)
+    # Equal affinity (none warm): lowest queue depth wins even when it
+    # appears LAST in the candidate list and has more in-flight.
+    a = Rep("a", queue_depth=5, in_flight=0)
+    b = Rep("b", queue_depth=2, in_flight=3)
+    assert r.place(seq, [a, b]) is b
+    assert r.place(seq, [b, a]) is b
+    # Warm cache outranks any queue: affinity first.
+    warm = Rep("w", queue_depth=9, in_flight=9, holds=True)
+    assert r.place(seq, [a, b, warm]) is warm
+    # Two equally-warm replicas: shorter queue wins the tie.
+    warm2 = Rep("v", queue_depth=1, in_flight=9, holds=True)
+    assert r.place(seq, [warm, warm2]) is warm2
+    # Full tie everywhere: name decides, independent of order.
+    c1, c2 = Rep("c1", 1, 1), Rep("c2", 1, 1)
+    assert r.place(seq, [c2, c1]) is c1
+    # A candidate without queue_depth falls back to in_flight.
+    plain = Rep("p", 0, 2)
+    del plain.queue_depth
+    busy = Rep("q", 3, 3)
+    assert r.place(seq, [busy, plain]) is plain
+
+
+def test_router_peek_matches_next_request_without_popping():
+    r = Router(tenant_weights={"a": 2.0, "b": 1.0})
+    r.submit(Request(np.array([1], np.int32), 4), tenant="b", now=0.0)
+    r.submit(Request(np.array([1], np.int32), 4), tenant="a", now=0.0)
+    head = r.peek()
+    assert head is r.peek()          # idempotent: nothing popped
+    assert r.queue_depth == 2
+    assert r.next_request() is head  # same WFQ order as the pop
+    assert r.peek() is not head
+    r.next_request()
+    assert r.peek() is None
+
+
 # -------------------------------------------------------------- autoscaler --
 def test_autoscaler_grow_shrink_from_synthetic_trace():
     asc = QueueAutoscaler(1, 3, queue_high=2.0, queue_low=0.5,

@@ -215,6 +215,11 @@ def test_pipelined_decode_is_memory_sharded(devices):
     assert "all-gather" not in hlo
 
 
+# @slow (tier-1 budget, PR 17): ~10s TP generate drive; TP numerics stay
+# in-tier via TestTensorParallel::test_tp_matches_single_device
+# (test_transformer.py) and greedy decode parity stays in-tier via the
+# single-device generate tests + the serving decode-parity suite.
+@pytest.mark.slow
 def test_generate_under_tensor_parallel_matches_single_device(devices):
     """Generation must work with Megatron-sharded params and produce the
     same greedy tokens as the unsharded model."""

@@ -134,6 +134,10 @@ def test_distributed_training_via_launcher(tmp_path):
     assert len(accs) == 1 and len(losses) == 1  # replicas in lockstep
 
 
+# @slow (tier-1 budget, PR 17): ~7s hung-worker wait; config
+# injection, error-capture, and CLI end-to-end stay in-tier, and the
+# restart-after-hang path is already @slow alongside this.
+@pytest.mark.slow
 def test_liveness_timeout_kills_hung_worker(tmp_path):
     """A worker that goes silent (SIGSTOP — alive but not beating) is
     killed with a 'liveness timeout' row within liveness_timeout, and its

@@ -117,6 +117,10 @@ def test_composes_with_head_chunks_accumulation_and_clip():
     assert_params_close(a, b, rtol=2e-4, atol=2e-5)
 
 
+# @slow (tier-1 budget, PR 17): ~5s composition cross-product; K under
+# single device stays in-tier (test_k8_matches_k1_losses_and_params) and
+# DP/pipeline numerics in their own suites — product only here.
+@pytest.mark.slow
 def test_under_data_parallel_with_pipeline(devices):
     """The stacked super-batch shards (None, 'data') under DP — K
     replicated, rows sharded — and fit(pipeline) collates through
@@ -159,6 +163,11 @@ def test_stacked_put_batch_sharding(devices):
     assert placed.addressable_shards[0].data.shape == (4, 2, 3)
 
 
+# @slow (tier-1 budget, PR 17): ~9s resume drive; K-aligned cursor math
+# stays in-tier via the epoch/tail schedule units, and checkpoint-resume
+# under chunking stays in-tier via test_chunked_head_checkpoint_resume
+# (the K x save_freq boundary matrix is already @slow per PR 15).
+@pytest.mark.slow
 def test_checkpoint_resume_k_aligned(tmp_path):
     """ModelCheckpoint resume under K: the restored cursor is K-aligned
     (every dispatch advances K full steps), and the resumed run replays

@@ -703,3 +703,56 @@ class TestCli:
 
     def test_missing_log(self, tmp_path, capsys):
         assert cli.main([str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_follow_tails_appends_and_rotation(self, tmp_path):
+        """--follow yields events as they are appended, waits for a log
+        that does not exist yet, and survives the writer's rotation
+        (new inode) — the EventLog stat/reopen idiom from the reader
+        side. Pull-based generator, so no threads needed to test it."""
+        path = tmp_path / "ev.jsonl"
+        gen = cli.follow(path, poll_s=0.01, stop=lambda: True)
+        assert list(gen) == []  # no file yet + stop(): clean exit
+        log = EventLog(path)
+        log.emit("attempt_start", attempt=1, world_size=2)
+        log.emit("stream_open", request_id=0, tenant="a")
+        deadline = time.time() + 10  # hang guard, not the exit path
+        seen = []
+        gen = cli.follow(path, poll_s=0.01,
+                         stop=lambda: time.time() > deadline)
+        for e in gen:
+            seen.append(e)
+            if len(seen) == 2:
+                break
+        assert [e["event"] for e in seen] == ["attempt_start",
+                                              "stream_open"]
+        # Append while the generator is live: the next pull gets it.
+        log.emit("quota_reject", tenant="flood")
+        assert next(gen)["event"] == "quota_reject"
+        # Rotate: unlink + fresh file. The tail reopens and keeps going.
+        log.close()
+        path.unlink()
+        log2 = EventLog(path)
+        log2.emit("run_complete", attempts=1)
+        assert next(gen)["event"] == "run_complete"
+        log2.close()
+        gen.close()
+
+    def test_follow_holds_back_torn_tail_line(self, tmp_path):
+        path = tmp_path / "ev.jsonl"
+        with open(path, "w") as f:
+            f.write('{"event": "restart", "ts": 1.0, "attempt": 1}\n')
+            f.write('{"event": "torn')  # no newline: write in progress
+        gen = cli.follow(path, poll_s=0.01, stop=lambda: True)
+        events = list(gen)
+        assert [e["event"] for e in events] == ["restart"]
+        # The tail completes -> the event is whole on the next tail.
+        with open(path, "a") as f:
+            f.write('_no_more", "ts": 2.0}\n')
+        events = list(cli.follow(path, poll_s=0.01, stop=lambda: True))
+        assert [e["event"] for e in events] == ["restart", "torn_no_more"]
+
+    def test_event_line_rendering(self):
+        line = cli.event_line({"ts": 0.0, "event": "replica_spawn",
+                               "pid": 1, "replica": "decode-0",
+                               "role": "decode"})
+        assert line.endswith("replica_spawn replica=decode-0 role=decode")

@@ -137,6 +137,10 @@ class TestFitPrefetchParity:
         assert ha.history["accuracy"] == hb.history["accuracy"]
         assert_params_equal(a, b)
 
+    # @slow (tier-1 budget, PR 17): ~5s prefetch x K x tail cross-
+    # product; depth-2 bit-exactness and the tail schedule stay in-tier
+    # in this class — this pins only the three-way composition.
+    @pytest.mark.slow
     def test_depth2_bitexact_under_multi_step_with_tail(self):
         """Prefetch composes with steps_per_execution=K, including the
         tail dispatch smaller than K (steps_per_epoch=5, K=4 -> 4+1)."""

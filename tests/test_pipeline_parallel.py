@@ -197,6 +197,11 @@ class TestDataPipelineParallel:
         with pytest.raises(ValueError, match="microbatches"):
             model.fit(x, y, batch_size=16, epochs=1, verbose=0)
 
+    # @slow (tier-1 budget, PR 17): ~7s convergence drive; pipeline
+    # numerics stay in-tier via test_pp_matches_single_device[pp2] and
+    # copy-task convergence of the same stack stays in-tier via
+    # TestTransformerTraining::test_learns_copy_task (test_transformer.py).
+    @pytest.mark.slow
     def test_learns_copy_task(self, devices):
         strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
         with strategy.scope():

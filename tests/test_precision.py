@@ -174,6 +174,10 @@ class TestLossParity:
 
 
 class TestComposition:
+    # @slow (tier-1 budget, PR 17): ~6s composition cross-product; loss
+    # parity per strategy stays in-tier (TestLossParity) as does plain
+    # grad_accum (test_zero.py) — this pins only their product.
+    @pytest.mark.slow
     def test_grad_accum_under_mixed(self, two_dev, lm_data, f32_run):
         """fit(grad_accum=2) under bf16: microbatch grads arrive bf16-
         computed but accumulate in f32 (the in-jit assert in
@@ -186,6 +190,10 @@ class TestComposition:
         np.testing.assert_allclose(losses, f32_run, rtol=5e-3)
         _assert_f32_masters(m)
 
+    # @slow (tier-1 budget, PR 17): ~7s composition cross-product; loss
+    # parity per strategy stays in-tier (TestLossParity) as does plain
+    # steps_per_execution (test_multi_step.py) — product only here.
+    @pytest.mark.slow
     def test_steps_per_execution_under_mixed(self, two_dev, lm_data,
                                              f32_run):
         """K=2 fused dispatch composes: the multi-step scan casts inside
@@ -273,6 +281,10 @@ class TestLossScaling:
 
 # --------------------------------------------------------------- checkpoint --
 class TestCheckpointRoundTrip:
+    # @slow (tier-1 budget, PR 17): ~7s cast-roundtrip drive; the
+    # mixed-tracks-f32 loss-parity tests stay in-tier, and the
+    # TIER1_PRECISION_SMOKE fast path (no marker filter) still runs this.
+    @pytest.mark.slow
     def test_mixed_to_f32_and_back(self, two_dev, lm_data, tmp_path):
         """Checkpoints hold the f32 masters, so save-under-mixed /
         restore-under-f32 (and the reverse) is EXACT — same bytes, same

@@ -92,6 +92,10 @@ def test_local_example_executes_and_trains():
     assert _scalar(interp.global_env.lookup("epochs")) == 3
 
 
+# @slow (tier-1 budget, PR 17): ~10s full local.R run; the R runtime
+# execution path stays in-tier via test_local_example_executes_and_trains
+# and result marshalling via test_evaluate_and_weight_roundtrip_from_r.
+@pytest.mark.slow
 def test_local_example_history_marshals_back():
     """fit's return value crosses back into R as a dtpu_history whose
     metrics are R double vectors (model.R:76-78); print.dtpu_history's
@@ -117,6 +121,10 @@ def test_local_example_history_marshals_back():
     assert "loss" in printed and "accuracy" in printed
 
 
+# @slow (tier-1 budget, PR 17): ~12s full local.R run; the R runtime
+# execution path stays in-tier via test_local_example_executes_and_trains
+# and R-side persistence via the reticulate weights-roundtrip test.
+@pytest.mark.slow
 def test_evaluate_and_weight_roundtrip_from_r(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     interp = make_interp()
@@ -403,6 +411,11 @@ def test_barrier_cluster_spec_port_munging():
     del os.environ["DTPU_CONFIG"]
 
 
+# @slow (tier-1 budget, PR 17): ~8s full local.R run; R-closure crossing
+# is exercised in-tier by test_local_example_executes_and_trains (loss fn
+# + metrics cross the same bridge) and the callback machinery is covered
+# jax-side in test_callbacks.py.
+@pytest.mark.slow
 def test_lr_scheduler_closure_crosses_to_python():
     """An R schedule closure handed to learning_rate_scheduler_callback
     must be callable from the Python side mid-fit (PyCallableFromR)."""

@@ -82,6 +82,11 @@ class TestRemat:
                 nn.Remat(nn.Dense(8, name="x")),
             ])
 
+    # @slow (tier-1 budget, PR 17): ~8s composition cross-product; remat
+    # training numerics stay in-tier via test_lm_remat_training_parity and
+    # pipeline numerics via test_pp_matches_single_device[pp2]
+    # (test_pipeline_parallel.py) — this pins their product only.
+    @pytest.mark.slow
     def test_pipelined_remat_matches_plain_pipeline(self):
         """transformer_lm(pipeline=True, remat=True) must train identically
         to the un-remat pipelined model (remat only reschedules)."""
@@ -158,6 +163,11 @@ class TestViT:
         _, _, out = m.init(jax.random.PRNGKey(0), (32, 32, 3))
         assert out == (10,)
 
+    # @slow (tier-1 budget, PR 17): ~6s convergence drive; ViT wiring
+    # stays pinned in-tier (shapes/param structure, named sizes, TP
+    # variants, scan-vs-unrolled param count + training), and separable-
+    # data convergence is covered in-tier by the mnist/transformer drives.
+    @pytest.mark.slow
     def test_learns_separable_data(self):
         x, y = dtpu.data.synthetic_images(256, (16, 16), 4, 0)
         x = np.repeat(x[..., None], 3, axis=-1).astype(np.float32) / 255.0

@@ -580,6 +580,11 @@ def _assert_parity(value, reference):
     np.testing.assert_allclose(value["leaf"], reference["leaf"], rtol=1e-6)
 
 
+# @slow (tier-1 budget, PR 17): ~8s real-process kill/restart; the
+# TestSupervisorUnit restart-policy tests stay in-tier, and the
+# serve_service kill test drives a real-process kill with token-exact
+# recovery every run.
+@pytest.mark.slow
 def test_supervisor_kill_restart_resume_parity(worker_script, reference_value,
                                                tmp_path):
     """ACCEPTANCE: fault-injected worker kill mid-epoch -> automatic

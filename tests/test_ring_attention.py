@@ -172,6 +172,11 @@ class TestDataSeqParallel:
         with pytest.raises(ValueError, match="divisible"):
             strategy.put_batch({"x": np.zeros((8, 18), np.int32)})
 
+    # @slow (tier-1 budget, PR 17): ~13s data x seq LM training drive; the
+    # data x seq mesh composition stays in-tier via test_data_x_seq_mesh
+    # and TestDataSeq::test_equals_dataseqparallel (test_composite.py), and
+    # ring-vs-dense numerics stay in-tier via the op-level parity tests.
+    @pytest.mark.slow
     def test_lm_trains_and_matches_dense(self, devices):
         VOCAB = 32
         rng = np.random.default_rng(0)

@@ -1,0 +1,443 @@
+"""dtpu-serve: framing, transport, quotas, and the real-process service.
+
+Layering mirrors the subsystem: the jax-free pieces (protocol framing,
+payload transport, token buckets) are pinned from plain sockets and
+tmpdirs with no model anywhere; the service tests then spawn REAL worker
+processes (``python -m distributed_tpu.serve_service.worker``) and hold
+the same decisive contract the fleet pinned in-process — every request
+served through the service, whatever kills or transport failures happen
+around it, produces exactly the tokens a sequential ``generate()``
+produces.
+
+Kept lean for the 1-core tier-1 box: worker spin-up is ~3 s (cold jax
+import + build + first compile per process), so ONE single-worker
+end-to-end test rides in tier-1 and the multi-process matrix (prefill
+handoff over shm, kill-a-replica, cross-process pool mismatch) is @slow.
+"""
+
+import io
+import os
+import socket
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.fleet import pack_kv
+from distributed_tpu.serve_service import (
+    MAGIC, ProtocolError, ServeService, ServeSpec, TenantQuotas,
+    TokenBucket, TransportError, ShmTransport, decode_payload,
+    encode_payload, handoff_to_payload, payload_to_handoff, recv_exact,
+    recv_frame, send_frame,
+)
+from distributed_tpu.serving import Request
+from distributed_tpu.serving.kv_cache import PagedKVCache
+from distributed_tpu.utils.events import read_events
+
+# --------------------------------------------------------------- protocol --
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_roundtrip_header_and_blobs():
+    a, b = _pair()
+    blobs = (b"\x00" * 17, b"payload-two", b"")
+    send_frame(a, {"type": "submit", "request_id": 3}, blobs)
+    header, got = recv_frame(b)
+    assert header == {"type": "submit", "request_id": 3}
+    assert [bytes(x) for x in got] == list(blobs)
+    # _blobs is framing-internal: popped before the header is returned.
+    assert "_blobs" not in header
+    a.close(), b.close()
+
+
+def test_clean_eof_between_frames_is_none():
+    a, b = _pair()
+    send_frame(a, {"type": "hello"})
+    a.close()
+    assert recv_frame(b)[0] == {"type": "hello"}
+    assert recv_frame(b) is None
+    b.close()
+
+
+@pytest.mark.parametrize("cut", [2, 4, 6, 10])
+def test_torn_frame_raises_at_every_boundary(cut):
+    """A peer dying mid-send must surface as ProtocolError — inside the
+    magic (2), after it (4), inside the header length (6), and inside
+    the header body (10). Never a short-but-plausible frame."""
+    buf = io.BytesIO()
+
+    class _Sink:
+        def sendall(self, data):
+            buf.write(data)
+
+    send_frame(_Sink(), {"type": "submit", "request_id": 1}, (b"kv",))
+    wire = buf.getvalue()
+    a, b = _pair()
+    a.sendall(wire[:cut])
+    a.close()
+    with pytest.raises(ProtocolError):
+        recv_frame(b)
+    b.close()
+
+
+def test_torn_blob_raises():
+    buf = io.BytesIO()
+
+    class _Sink:
+        def sendall(self, data):
+            buf.write(data)
+
+    send_frame(_Sink(), {"type": "prefilled"}, (b"x" * 64,))
+    wire = buf.getvalue()
+    a, b = _pair()
+    a.sendall(wire[:-10])  # last blob short by 10 bytes
+    a.close()
+    with pytest.raises(ProtocolError):
+        recv_frame(b)
+    b.close()
+
+
+def test_bad_magic_and_corrupt_length_raise():
+    a, b = _pair()
+    a.sendall(b"HTTP" + b"\x00" * 16)
+    with pytest.raises(ProtocolError, match="magic"):
+        recv_frame(b)
+    a2, b2 = _pair()
+    a2.sendall(MAGIC + b"\xff\xff\xff\xff")  # 4 GiB header: corrupt
+    with pytest.raises(ProtocolError, match="header length"):
+        recv_frame(b2)
+    for s in (a, b, a2, b2):
+        s.close()
+
+
+def test_recv_exact_short_read():
+    a, b = _pair()
+    a.sendall(b"abc")
+    a.close()
+    with pytest.raises(ProtocolError, match="3 of 5"):
+        recv_exact(b, 5)
+    b.close()
+
+
+# -------------------------------------------------------------- transport --
+
+
+def _payload(seed=0, nblocks=3):
+    rng = np.random.default_rng(seed)
+    return {
+        "blocks": {
+            f"layer{i}/k@0@(4,2)": rng.standard_normal((4, 2)).astype(
+                np.float32)
+            for i in range(nblocks)
+        },
+        "cached_len": 9,
+        "block_size": 4,
+        "dtype": "float32",
+        "prefix_hashes": [1, 2, 3],
+        "skip_blocks": 0,
+    }
+
+
+def test_encode_decode_payload_roundtrip():
+    p = _payload()
+    meta, blobs = encode_payload(p)
+    assert meta["cached_len"] == 9 and len(blobs) == len(p["blocks"])
+    out = decode_payload(meta, blobs)
+    assert out["block_size"] == 4 and out["prefix_hashes"] == [1, 2, 3]
+    for key, arr in p["blocks"].items():
+        np.testing.assert_array_equal(out["blocks"][key], arr)
+
+
+def test_decode_payload_count_mismatch_and_corrupt_blob():
+    meta, blobs = encode_payload(_payload())
+    with pytest.raises(TransportError):
+        decode_payload(meta, blobs[:-1])
+    with pytest.raises(TransportError):
+        decode_payload(meta, [b"not-an-npy"] + list(blobs[1:]))
+
+
+def test_shm_transport_roundtrip_and_delete(tmp_path):
+    tr = ShmTransport(tmp_path / "kv", owner=True)
+    p = _payload(seed=1)
+    ref = tr.put(p)
+    out = tr.get(ref)
+    for key, arr in p["blocks"].items():
+        np.testing.assert_array_equal(np.asarray(out["blocks"][key]), arr)
+    tr.delete(ref)
+    with pytest.raises(TransportError):
+        tr.get(ref)
+    tr.close()
+    assert not (tmp_path / "kv").exists()
+
+
+def test_shm_put_is_atomic_commit(tmp_path):
+    """The manifest is the commit marker (os.replace of the whole dir):
+    a payload directory without one — a writer killed mid-put — must
+    read as TransportError, never as a truncated payload."""
+    tr = ShmTransport(tmp_path / "kv")
+    ref = tr.put(_payload())
+    os.unlink(os.path.join(ref["path"], "manifest.json"))
+    with pytest.raises(TransportError):
+        tr.get(ref)
+
+
+# ----------------------------------------------------------------- quotas --
+
+
+def test_token_bucket_all_or_nothing_and_refill():
+    b = TokenBucket(rate=10.0, burst=20.0)
+    assert b.try_take(20.0, now=0.0)       # full bucket drains
+    assert not b.try_take(1.0, now=0.0)    # empty: all-or-nothing
+    assert b.retry_after(1.0) == pytest.approx(0.1)
+    assert b.try_take(1.0, now=0.2)        # 2 tokens refilled
+    # A cost beyond burst reports the finite full-refill horizon.
+    assert np.isfinite(b.retry_after(10_000.0))
+
+
+def test_tenant_quotas_unlisted_unmetered():
+    q = TenantQuotas({"flood": (1.0, 4.0)})
+    ok, retry = q.admit("anyone", 1000.0, now=0.0)
+    assert ok and retry is None
+    assert q.admit("flood", 4.0, now=0.0) == (True, None)
+    ok, retry = q.admit("flood", 4.0, now=0.0)
+    assert not ok and retry > 0
+    t = q.telemetry()
+    assert t["rejected"] == 1 and t["rejected_by_tenant"] == {"flood": 1}
+
+
+# ------------------------------------------------- payload <-> KVHandoff --
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=1, d_model=16, num_heads=2, max_len=64))
+    model.compile(optimizer="sgd", loss="sparse_categorical_crossentropy")
+    model.build((64,))
+    return model
+
+
+def test_payload_handoff_roundtrip(lm):
+    """handoff -> dict payload -> (encode/decode) -> KVHandoff preserves
+    every block byte and all trim metadata."""
+    import jax
+    kv = PagedKVCache(lm.module, lm.params, max_slots=2, block_size=4,
+                      max_blocks_per_seq=8, num_blocks=9, dtype=np.float32)
+    assert kv.reserve(0, 10)
+    rng = np.random.default_rng(0)
+    leaves, treedef = jax.tree_util.tree_flatten(kv.caches)
+    kv.caches = jax.tree_util.tree_unflatten(treedef, [
+        jax.numpy.asarray(rng.normal(size=l.shape).astype(np.float32))
+        for l in leaves
+    ])
+    prompt = np.arange(10, dtype=np.int32) % 32
+    h = pack_kv(kv, 0, 10, tokens=prompt)
+    p = handoff_to_payload(h)
+    meta, blobs = encode_payload(p)
+    back = payload_to_handoff(decode_payload(meta, blobs))
+    assert back.cached_len == h.cached_len
+    assert back.block_size == h.block_size
+    assert back.prefix_hashes == h.prefix_hashes
+    assert set(back.blocks) == set(h.blocks)
+    for key in h.blocks:
+        np.testing.assert_array_equal(np.asarray(back.blocks[key]),
+                                      np.asarray(h.blocks[key]))
+
+
+# ---------------------------------------------------------------- service --
+
+_MODEL = dict(vocab_size=32, num_layers=1, d_model=16, num_heads=2,
+              max_len=64)
+
+
+def _spec(**kw):
+    kw.setdefault("model", dict(_MODEL))
+    kw.setdefault("build_len", 64)
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("max_len", 64)
+    return ServeSpec(**kw)
+
+
+@pytest.fixture(scope="module")
+def reference(lm):
+    """Sequential greedy generate() in THIS process: Model.build is
+    seed-deterministic, so worker processes hold byte-identical params
+    and the service outputs must match these exactly."""
+    def gen(prompts, news):
+        return [np.asarray(lm.generate(p[None], m, temperature=0.0)[0])
+                for p, m in zip(prompts, news)]
+    return gen
+
+
+def _requests(n, seed=3, vocab=32, m=6):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, (int(t),)).astype(np.int32)
+               for t in rng.integers(2, 7, n)]
+    return prompts, [m] * n
+
+
+def test_service_end_to_end_streams_token_exact(lm, reference, tmp_path):
+    """One real decode worker process: every output token-exact vs the
+    in-process generate(), the streaming iterator delivers exactly the
+    final output's generated suffix, and the wall-clock telemetry is
+    sane. The multi-replica / kill / handoff matrix is @slow below."""
+    os.environ["DTPU_EVENT_LOG"] = str(tmp_path / "events.jsonl")
+    try:
+        prompts, news = _requests(3)
+        svc = ServeService(_spec(), decode_replicas=1, transport="none",
+                           log_dir=tmp_path)
+        with svc:
+            streams = []
+            for p, m in zip(prompts, news):
+                adm, stream = svc.submit(Request(p, m, seed=0))
+                assert adm.accepted
+                streams.append(stream)
+            got = [list(iter(s)) for s in streams]   # pumps the service
+            outs = [s.result() for s in streams]
+        ref = reference(prompts, news)
+        for r, o in zip(ref, outs):
+            np.testing.assert_array_equal(r, o)
+        for p, toks, o in zip(prompts, got, outs):
+            assert toks == [int(t) for t in o[len(p):]]
+        evts = {e["event"] for e in
+                read_events(os.environ["DTPU_EVENT_LOG"])}
+        assert {"service_start", "replica_spawn", "stream_open"} <= evts
+    finally:
+        del os.environ["DTPU_EVENT_LOG"]
+
+
+@pytest.mark.slow
+def test_service_prefill_handoff_over_shm(lm, reference, tmp_path):
+    """Disaggregated pools as real processes: prompts prefill on the
+    prefill worker, KV rides /dev/shm as .npy blocks, decode installs
+    without re-prefilling — and outputs stay token-exact."""
+    prompts, news = _requests(3, seed=5)
+    svc = ServeService(_spec(), decode_replicas=1, prefill_replicas=1,
+                       transport="shm", log_dir=tmp_path)
+    with svc:
+        res = svc.run([Request(p, m, seed=0)
+                       for p, m in zip(prompts, news)], deadline_s=180)
+        stats = svc.collect_stats()
+    ref = reference(prompts, news)
+    for r, o in zip(ref, res):
+        np.testing.assert_array_equal(r, o)
+    decode = [s for s in stats.values() if s.get("role") == "decode"]
+    assert sum(s["handoffs_installed"] for s in decode) == 3
+    assert sum(s["handoffs_fallback"] for s in decode) == 0
+    prefill = [s for s in stats.values() if s.get("role") == "prefill"]
+    assert sum(s["prefills"] for s in prefill) == 3
+    assert res.telemetry["lost_requests"] == 0
+
+
+@pytest.mark.slow
+def test_service_kill_replica_recovers_token_exact(lm, reference,
+                                                   tmp_path):
+    """Kill a decode worker PROCESS mid-decode: zero lost requests,
+    outputs token-exact (survivor re-prefills prompt+streamed context,
+    greedy continuation is deterministic), and the dead worker leaves a
+    readable flight-recorder postmortem referenced from the event log."""
+    os.environ["DTPU_EVENT_LOG"] = str(tmp_path / "events.jsonl")
+    try:
+        prompts, news = _requests(6, seed=7, m=8)
+        svc = ServeService(_spec(), decode_replicas=2, transport="none",
+                           respawn=False, log_dir=tmp_path)
+        with svc:
+            streams = []
+            for p, m in zip(prompts, news):
+                adm, stream = svc.submit(Request(p, m, seed=0))
+                assert adm.accepted
+                streams.append(stream)
+            while svc.streamed_tokens < 6:
+                svc._pump(0.02)
+            svc.kill_replica("decode-1")
+            for s in streams:
+                for _ in iter(s):
+                    pass
+            outs = [s.result() for s in streams]
+            kills = svc.kills
+        ref = reference(prompts, news)
+        for r, o in zip(ref, outs):
+            np.testing.assert_array_equal(r, o)
+        assert kills == 1
+        evts = read_events(os.environ["DTPU_EVENT_LOG"])
+        dead = [e for e in evts if e["event"] == "fleet_replica_killed"]
+        assert dead and dead[0]["replica"] == "decode-1"
+        dumps = [e for e in evts if e["event"] == "flight_dump"]
+        assert dumps and os.path.exists(dumps[0]["path"])
+        from distributed_tpu.obs.cli import summarize
+        post = summarize(evts)
+        flight = post["flight_dumps"]
+        assert flight and flight[0]["readable"]
+        assert flight[0]["reason"] == "replica_kill"
+    finally:
+        del os.environ["DTPU_EVENT_LOG"]
+
+
+@pytest.mark.slow
+def test_service_pool_mismatch_falls_back_to_reprefill(lm, reference,
+                                                       tmp_path):
+    """Heterogeneous pools across PROCESSES (prefill block_size 4,
+    decode block_size 8): the incompatibility is detected pre-scatter on
+    the decode side (the PR 11 HandoffIncompatible contract, now across
+    a real transport), every request re-prefills, a transport_fallback
+    event names the reason — and outputs are still token-exact."""
+    os.environ["DTPU_EVENT_LOG"] = str(tmp_path / "events.jsonl")
+    try:
+        prompts, news = _requests(2, seed=9)
+        svc = ServeService(_spec(), decode_replicas=1, prefill_replicas=1,
+                           transport="shm", log_dir=tmp_path,
+                           engine_overrides={"decode": {"block_size": 8}})
+        with svc:
+            res = svc.run([Request(p, m, seed=0)
+                           for p, m in zip(prompts, news)], deadline_s=180)
+            stats = svc.collect_stats()
+        ref = reference(prompts, news)
+        for r, o in zip(ref, res):
+            np.testing.assert_array_equal(r, o)
+        decode = [s for s in stats.values()
+                  if s.get("role") == "decode"][0]
+        assert decode["handoffs_installed"] == 0
+        assert decode["handoffs_fallback"] == 2
+        falls = [e for e in read_events(os.environ["DTPU_EVENT_LOG"])
+                 if e["event"] == "transport_fallback"]
+        assert len(falls) == 2
+        assert all("block_size" in f["reason"] for f in falls)
+    finally:
+        del os.environ["DTPU_EVENT_LOG"]
+
+
+@pytest.mark.slow
+def test_service_quotas_and_autoscaler_live(lm, tmp_path):
+    """Front-door quotas against real workers (flooder throttled before
+    the queue, unmetered tenant unaffected) and the QueueAutoscaler
+    driving a real second process up and back down."""
+    from distributed_tpu.fleet import QueueAutoscaler
+    prompts, news = _requests(10, seed=11, m=8)
+    svc = ServeService(
+        _spec(max_slots=1), decode_replicas=1, transport="none",
+        quotas=TenantQuotas({"flood": (1.0, 12.0)}),
+        autoscaler=QueueAutoscaler(min_replicas=1, max_replicas=2,
+                                   queue_high=1.5, queue_low=0.25,
+                                   cooldown_s=0.5),
+        log_dir=tmp_path,
+    )
+    with svc:
+        res = svc.run(
+            [Request(p, m, seed=0) for p, m in zip(prompts, news)],
+            tenants=["flood"] * 8 + ["paying", "paying"],
+            deadline_s=180,
+        )
+    tel = res.telemetry
+    assert tel["quotas"]["rejected"] > 0
+    assert tel["lost_requests"] == 0
+    assert res[8] is not None and res[9] is not None
+    assert tel["decode_pool"]["spawns"] >= 2  # autoscaler spawned live
+    assert any(e["to"] > e["from"]
+               for e in tel["decode_pool"]["events"])

@@ -74,6 +74,10 @@ def test_sync_check_callback_passes_on_healthy_run_and_validates():
         SyncCheck(every=0)
 
 
+# @slow (tier-1 budget, PR 17): ~9s subprocess launcher drive; the
+# in-process divergence tests (diverged_replica_is_caught, healthy-run
+# zero-drift) stay in-tier and pin the same detector.
+@pytest.mark.slow
 def test_cross_host_divergence_caught_via_launcher(tmp_path):
     """2-process gang (1 CPU device each): the local replica check has
     nothing to compare, so only the cross-host fingerprint path can catch
