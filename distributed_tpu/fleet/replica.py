@@ -55,13 +55,21 @@ class EnginePrograms:
     XLA programs — replica count never multiplies compiles."""
 
     def __init__(self, model, *, temperature: float = 0.0,
-                 top_k: Optional[int] = None, seed: int = 0):
+                 top_k: Optional[int] = None, seed: int = 0,
+                 decode_kernel: str = "reference"):
         if not model.built:
             raise RuntimeError("Model not built")
+        from ..ops import paged_attention as paged_ops
+        if decode_kernel not in paged_ops.KINDS:
+            raise ValueError(
+                f"decode_kernel must be one of {paged_ops.KINDS}, got "
+                f"{decode_kernel!r}"
+            )
         self.model = model
         self.temperature = float(temperature)
         self.top_k = top_k
         self.seed = int(seed)
+        self.decode_kernel = decode_kernel
         self.prefill_fn = model._scoped(jax.jit(
             functools.partial(
                 _prefill_dispatch, model.module, self.temperature,
@@ -69,13 +77,24 @@ class EnginePrograms:
             ),
             donate_argnums=(2,),
         ))
-        self.decode_fn = model._scoped(jax.jit(
+        decode_fn = model._scoped(jax.jit(
             functools.partial(
                 _decode_dispatch, model.module, self.temperature,
                 self.top_k, model.precision, model._dtype_hints,
             ),
             donate_argnums=(2,),
         ))
+        if decode_kernel == paged_ops.FUSED:
+            # Same trace-time selection as Engine._with_kernel: the scope
+            # is ambient while the decode dispatch first traces, so every
+            # replica sharing these programs rides the fused kernel.
+            inner = decode_fn
+
+            @functools.wraps(inner)
+            def decode_fn(*args, **kwargs):
+                with paged_ops.decode_kernel_scope(paged_ops.FUSED):
+                    return inner(*args, **kwargs)
+        self.decode_fn = decode_fn
 
     def token_key(self, seq: Sequence) -> np.ndarray:
         """Per-request, per-token sampling key (the engine's derivation):

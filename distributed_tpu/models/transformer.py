@@ -69,6 +69,7 @@ def transformer_lm(
     moe_every: int = 2,
     pipeline: bool = False,
     scan: bool = False,
+    scan_overlap: str = "auto",
     remat: bool = False,
     remat_policy=None,
     flash="auto",
@@ -87,6 +88,9 @@ def transformer_lm(
     weight-stacked blocks, keeping static op count and compile time
     depth-independent; generation works through stacked KV caches
     (ScannedBlocks.decode scans the cached one-token step over the stack).
+    ``scan_overlap`` forwards ``ScannedBlocks(overlap=)`` ('auto' | 'off' |
+    'require'): under an FSDP-family strategy the scan prefetches layer
+    i+1's parameter all-gather behind layer i's compute.
     ``remat=True`` wraps every attention/FFN residual in ``nn.Remat`` —
     backward recomputes block activations instead of holding them in HBM
     (identical numerics and checkpoint paths, O(1)-blocks activation
@@ -116,8 +120,12 @@ def transformer_lm(
             )
             return nn.Remat(block, policy=remat_policy) if remat else block
 
-        stack = nn.PipelinedBlocks if pipeline else nn.ScannedBlocks
-        layers.append(stack(make_block, num_layers))
+        if pipeline:
+            layers.append(nn.PipelinedBlocks(make_block, num_layers))
+        else:
+            layers.append(nn.ScannedBlocks(
+                make_block, num_layers, overlap=scan_overlap,
+            ))
     else:
         for i in range(num_layers):
             moe = moe_experts if (moe_experts and i % moe_every == moe_every - 1) else 0

@@ -319,20 +319,31 @@ class MultiHeadAttention(Layer):
             "v": jnp.zeros(shape, cdtype),
         }
 
-    def _paged_view(self, pool, block_tables, out_dtype=None):
+    def _paged_view(self, pool, block_tables, out_dtype=None, *,
+                    visible=None):
         """Gather per-slot blocks into a contiguous (S, nb*bs, H, hd) view
         (logical position j of slot s lives at block_tables[s, j // bs],
         offset j % bs). Plain pools return their own dtype (``out_dtype``
         ignored — the f32/bf16 program is unchanged); int8 pools gather
-        q + scale and dequantize IN-TRACE to ``out_dtype``."""
+        q + scale and dequantize IN-TRACE to ``out_dtype``.
+
+        ``visible`` ((S, L) bool, L = nb*bs): rows the caller's causal
+        mask can ever expose. On the int8 path masked rows are zeroed
+        BEFORE the dequantize multiply (payload -> 0, scale -> 1), so
+        trash-block / stale rows dequantize to exact zeros instead of
+        ``garbage * scale`` — the reference view then agrees bit-for-bit
+        with the fused kernel (ops.paged_attention), which never weights
+        those rows, and the dequantize does no work the mask would
+        discard. Plain pools ignore it (their masked rows are never
+        multiplied un-masked either way)."""
         if isinstance(pool, dict):
-            return dequantize(
-                {
-                    QKEY: self._paged_view(pool[QKEY], block_tables),
-                    SKEY: self._paged_view(pool[SKEY], block_tables),
-                },
-                out_dtype,
-            )
+            qv = self._paged_view(pool[QKEY], block_tables)
+            sv = self._paged_view(pool[SKEY], block_tables)
+            if visible is not None:
+                vis = visible[:, :, None, None]
+                qv = jnp.where(vis, qv, jnp.zeros_like(qv))
+                sv = jnp.where(vis, sv, jnp.ones_like(sv))
+            return dequantize({QKEY: qv, SKEY: sv}, out_dtype)
         gathered = pool[block_tables]  # (S, nb, bs, H, hd)
         s, nb, bs, h, hd = gathered.shape
         return gathered.reshape(s, nb * bs, h, hd)
@@ -367,19 +378,36 @@ class MultiHeadAttention(Layer):
         off = positions % bs
         ck = _kv_scatter(cache["k"], blk, off, k)
         cv = _kv_scatter(cache["v"], blk, off, v)
-        view_k = self._paged_view(ck, block_tables, q.dtype)  # (S, L, H, hd)
-        view_v = self._paged_view(cv, block_tables, q.dtype)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, view_k,
-            preferred_element_type=jnp.float32,
-        ) / jnp.sqrt(jnp.float32(hd))  # (S, H, 1, L)
-        visible = jnp.arange(view_k.shape[1])[None] <= positions[:, None]
-        scores = jnp.where(
-            visible[:, None, None, :], scores, jnp.float32(-1e30)
-        )
-        attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", attn, view_v).reshape(s, 1,
-                                                                  h * hd)
+        from ..ops import paged_attention as paged_ops
+        if paged_ops.current_decode_kernel() == paged_ops.FUSED:
+            # Fused gather + attention: the block table rides into the
+            # kernel as a scalar-prefetch operand; no (S, L, H, hd) view
+            # is ever materialized. Scatter stays plain XLA above.
+            ctx = paged_ops.paged_attention(
+                q, ck, cv, block_tables, positions
+            ).reshape(s, 1, h * hd)
+        else:
+            visible = (
+                jnp.arange(block_tables.shape[1] * bs)[None]
+                <= positions[:, None]
+            )  # (S, L)
+            view_k = self._paged_view(
+                ck, block_tables, q.dtype, visible=visible
+            )  # (S, L, H, hd)
+            view_v = self._paged_view(
+                cv, block_tables, q.dtype, visible=visible
+            )
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, view_k,
+                preferred_element_type=jnp.float32,
+            ) / jnp.sqrt(jnp.float32(hd))  # (S, H, 1, L)
+            scores = jnp.where(
+                visible[:, None, None, :], scores, jnp.float32(-1e30)
+            )
+            attn = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            ctx = jnp.einsum(
+                "bhqk,bkhd->bqhd", attn, view_v
+            ).reshape(s, 1, h * hd)
         out = jnp.dot(ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype))
         if self.use_bias:
             out = out + params["bo"].astype(out.dtype)
@@ -420,8 +448,31 @@ class MultiHeadAttention(Layer):
         off = abs_pos % bs  # (S, K)
         ck = _kv_scatter(cache["k"], blk, off, k)
         cv = _kv_scatter(cache["v"], blk, off, v)
-        view_k = self._paged_view(ck, block_tables, q.dtype)  # (S, L, H, hd)
-        view_v = self._paged_view(cv, block_tables, q.dtype)
+        from ..ops import paged_attention as paged_ops
+        if paged_ops.current_decode_kernel() == paged_ops.FUSED:
+            # Same fused kernel as decode: candidate row k of slot s
+            # masks itself to positions <= positions[s] + k in-kernel.
+            ctx = paged_ops.paged_attention(
+                q, ck, cv, block_tables, positions
+            ).reshape(s, kw, h * hd)
+            out = jnp.dot(
+                ctx, maybe_dequantize(params["wo"]).astype(ctx.dtype)
+            )
+            if self.use_bias:
+                out = out + params["bo"].astype(out.dtype)
+            return out, {"k": ck, "v": cv}
+        ll = block_tables.shape[1] * bs
+        # Per-slot union of the K candidates' causal windows — what any
+        # row of this dispatch can ever expose (the view-level mask).
+        row_vis = (
+            jnp.arange(ll)[None, :] <= (positions + kw - 1)[:, None]
+        )  # (S, L)
+        view_k = self._paged_view(
+            ck, block_tables, q.dtype, visible=row_vis
+        )  # (S, L, H, hd)
+        view_v = self._paged_view(
+            cv, block_tables, q.dtype, visible=row_vis
+        )
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q, view_k,
             preferred_element_type=jnp.float32,
@@ -469,8 +520,14 @@ class MultiHeadAttention(Layer):
         off = abs_pos % bs
         ck = _kv_scatter(cache["k"], blk, off, k)
         cv = _kv_scatter(cache["v"], blk, off, v)
-        view_k = self._paged_view(ck, block_table[None], q.dtype)[0]
-        view_v = self._paged_view(cv, block_table[None], q.dtype)[0]
+        ll = block_table.shape[0] * bs
+        chunk_vis = (jnp.arange(ll) <= start + c - 1)[None]  # (1, L)
+        view_k = self._paged_view(
+            ck, block_table[None], q.dtype, visible=chunk_vis
+        )[0]
+        view_v = self._paged_view(
+            cv, block_table[None], q.dtype, visible=chunk_vis
+        )[0]
         scores = jnp.einsum(
             "bqhd,khd->bhqk", q, view_k,
             preferred_element_type=jnp.float32,

@@ -20,6 +20,7 @@ and threaded through the scan as per-iteration inputs/outputs.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 import jax
@@ -27,6 +28,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from .core import Layer, Shape
+
+# Trace-time record of the most recent ScannedBlocks.apply on this thread:
+# whether the gather overlap engaged and over how many layers. Model.fit's
+# telemetry exit reads it (training/model.py) to attribute exposed
+# communication without a layer-tree traversal protocol — best-effort by
+# design, like the threadlocal strategy scope it mirrors.
+_overlap_trace = threading.local()
+
+
+def last_overlap_trace() -> Optional[dict]:
+    """``{"layers": int, "active": bool}`` from the most recent scanned
+    apply traced on this thread, or None before any."""
+    return getattr(_overlap_trace, "record", None)
 
 
 def init_stacked_blocks(
@@ -73,11 +87,25 @@ def init_stacked_blocks(
     return params, state
 
 
-def scan_stacked(block, stacked_p, stacked_s, x, *, train, rngs):
+def scan_stacked(block, stacked_p, stacked_s, x, *, train, rngs,
+                 overlap_gather=None):
     """Apply a stack of block params (and optional stacked state) to x as
     one ``lax.scan``. Returns (y, stacked_new_state). Shared by
     ScannedBlocks and PipelinedBlocks' sequential path — the 'identical
-    numerics' contract both promise lives here."""
+    numerics' contract both promise lives here.
+
+    ``overlap_gather`` (from ``Strategy.overlap_spec``): when given, the
+    scan double-buffers the per-layer parameter gather. Iteration i's
+    carry already holds layer i's GATHERED params; the body's first act is
+    to issue layer i+1's gather (its xs slice arrives SHARDED — the
+    stacked params ride through the scan rolled by -1 so slice i is layer
+    i+1), which depends only on the slice, not on layer i's compute — the
+    scheduler is free to run the all-gather behind the layer's matmuls
+    instead of serializing it in front of them. Only layer 0's warm-up
+    gather (issued before the scan) has nothing to hide behind. The final
+    iteration's wrap-around gather (layer 0 again, from the roll) is
+    dead code XLA drops. Values are identical to the plain body:
+    gathering is a layout constraint, not arithmetic."""
 
     def body(h, per_iter):
         p, s, r = per_iter
@@ -85,6 +113,32 @@ def scan_stacked(block, stacked_p, stacked_s, x, *, train, rngs):
         # Carry dtype must be stable across iterations (a bf16-compute
         # block in an f32 stream behaves like any mixed-precision layer).
         return y.astype(h.dtype), new_s
+
+    if overlap_gather is not None:
+        p0 = jax.tree_util.tree_map(lambda l: l[0], stacked_p)
+        g0 = overlap_gather(p0)
+        rolled = jax.tree_util.tree_map(
+            lambda l: jnp.roll(l, -1, axis=0), stacked_p
+        )
+
+        def body_overlap(carry, per_iter):
+            h, g = carry
+            p_next, s, r = per_iter
+            g_next = overlap_gather(p_next)
+            y, new_s = block.apply(g, s, h, train=train, rng=r)
+            return (y.astype(h.dtype), g_next), new_s
+
+        if rngs is None:
+            (out, _), new_s = lax.scan(
+                lambda c, ps: body_overlap(c, (ps[0], ps[1], None)),
+                (x, g0),
+                (rolled, stacked_s),
+            )
+        else:
+            (out, _), new_s = lax.scan(
+                body_overlap, (x, g0), (rolled, stacked_s, rngs)
+            )
+        return out, new_s
 
     if rngs is None:
         return lax.scan(
@@ -161,12 +215,26 @@ class ScannedBlocks(Layer):
         block_fn: Callable[[], Layer],
         num_blocks: int,
         *,
+        overlap: str = "auto",
         name: Optional[str] = None,
     ):
+        """``overlap``: comm/compute overlap for the per-layer parameter
+        gather. 'auto' (default) double-buffers the gather whenever the
+        AMBIENT strategy provides one (``Strategy.overlap_spec`` — the
+        FSDP family; resolved at trace time, so one module serves every
+        strategy); 'off' keeps the plain scan body under every strategy;
+        'require' raises at trace time if the strategy has no gather to
+        overlap (use it to make a perf assumption loud)."""
         super().__init__(name)
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if overlap not in ("auto", "off", "require"):
+            raise ValueError(
+                "overlap must be 'auto', 'off' or 'require', got "
+                f"{overlap!r}"
+            )
         self.num_blocks = int(num_blocks)
+        self.overlap = overlap
         self.block_fn = block_fn
         self.block = block_fn()  # template: defines structure + names
 
@@ -212,13 +280,34 @@ class ScannedBlocks(Layer):
         out_s = {"blocks": state} if jax.tree_util.tree_leaves(state) else {}
         return {"blocks": params}, out_s, shape
 
+    def _overlap_gather(self):
+        """Resolve the ambient strategy's gather at TRACE time (the
+        ``current_strategy`` idiom — strategy scopes are entered around
+        every jitted step body by ``Model._scoped``)."""
+        if self.overlap == "off":
+            return None
+        from ..parallel.strategy import current_strategy
+        strat = current_strategy()
+        gather = strat.overlap_spec() if strat is not None else None
+        if gather is None and self.overlap == "require":
+            raise ValueError(
+                "ScannedBlocks(overlap='require') needs an ambient "
+                "strategy with an overlap_spec gather (the FSDP family); "
+                f"got {type(strat).__name__ if strat else None}"
+            )
+        return gather
+
     def apply(self, params, state, x, *, train=False, rng=None):
         rngs = (
             jax.random.split(rng, self.num_blocks) if rng is not None else None
         )
+        gather = self._overlap_gather()
+        _overlap_trace.record = {
+            "layers": self.num_blocks, "active": gather is not None,
+        }
         out, new_s = scan_stacked(
             self.block, params["blocks"], state.get("blocks", {}), x,
-            train=train, rngs=rngs,
+            train=train, rngs=rngs, overlap_gather=gather,
         )
         # Blocks that return no state (eval-mode BatchNorm, stateless
         # blocks) produce an empty ys tree; mirror Sequential's "omit when

@@ -12,6 +12,7 @@ _LAZY_SUBMODULES = (
     "ring_attention",
     "pallas_kernels",
     "fused_update",
+    "paged_attention",
 )
 
 __all__ = ["losses", "metrics", *_LAZY_SUBMODULES]

@@ -28,3 +28,20 @@ def round_up(v: int, m: int) -> int:
 # Column/score padding value shared by the attention-family kernels:
 # exp(NEG - max) == 0, and NEG is large enough to never be the row max.
 NEG = -1e30
+
+# TPU vector-lane width: the last-dim tile size every kernel in this
+# package pads or packs to (flash_attention's head packing, fused_update's
+# flat segments, paged_attention's head-flattened pools).
+LANES = 128
+
+
+def packed_supported(num_heads: int, head_dim: int) -> bool:
+    """True when ``num_heads`` heads of ``head_dim`` columns tile the
+    128-lane vector exactly — the precondition for the lane-packed
+    attention kernels (several heads share one lane vector, so a python
+    per-head loop over lane slices stays a static unrolled body)."""
+    return (
+        head_dim <= LANES
+        and LANES % head_dim == 0
+        and num_heads % (LANES // head_dim) == 0
+    )

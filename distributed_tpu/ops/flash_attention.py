@@ -37,8 +37,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 from ._pallas_common import (
+    LANES as _LANES,
     NEG as _NEG,
     interpret as _interpret,
+    packed_supported as _packed_supported,
     round_up as _round_up,
 )
 
@@ -371,18 +373,12 @@ def _bwd_pallas(res, g, *, scale, causal, block_q, block_k):
 # Requires 128 % D == 0 and H % (128//D) == 0 (covers head_dim 64/128);
 # other shapes fall back to the folded path.
 
-_LANES = 128
-
 # Sequence length (padded) above which the packed kernels save their row
 # stats compactly ((b, nh, t_pad, heads_per_block)) and re-expand in the
 # backward: the lane-replicated form reads fastest under Mosaic but costs
 # 128/heads_per_block x the residual memory, which only matters once T is
 # long enough for stats to rival the activations themselves.
 _COMPACT_STATS_MIN_T = 2048
-
-
-def _packed_supported(h: int, d: int) -> bool:
-    return d <= _LANES and _LANES % d == 0 and h % (_LANES // d) == 0
 
 
 def _fwd_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_out_ref, l_out_ref,

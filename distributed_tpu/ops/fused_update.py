@@ -55,13 +55,16 @@ import numpy as np
 import optax
 from jax.experimental import pallas as pl
 
-from ._pallas_common import interpret as _interpret, round_up as _round_up
+from ._pallas_common import (
+    LANES as _LANES,
+    interpret as _interpret,
+    round_up as _round_up,
+)
 
 # Rows (of 128 lanes) per grid block: 256*128 f32 = 128 KiB per operand;
 # the kernel holds 5 inputs + 3 outputs + temporaries, comfortably inside
 # the ~16 MB VMEM budget.
 _BLOCK_ROWS = 256
-_LANES = 128
 
 
 class FusedAdamState(NamedTuple):

@@ -149,6 +149,21 @@ class Strategy:
         nothing)."""
         return params
 
+    def overlap_spec(self):
+        """Comm/compute-overlap seam for the per-layer scan
+        (``nn.ScannedBlocks``). Strategies whose parameters are SHARDED
+        and gathered per layer (FSDP family) return a gather callable —
+        one layer's (sharded) param slice -> the same tree constrained to
+        a fully replicated layout, i.e. an explicit all-gather the scan
+        body can issue one layer AHEAD of use, so layer i+1's gather has
+        no data dependency on layer i's compute and the scheduler can
+        overlap the two (the collective-matmul idiom). Composes with
+        ``constrain_compute_params`` and the precision cast: the slice
+        arriving at the gather is already the compute-dtype shard copy,
+        so bf16 moves on the wire. ``None`` (default) = params are
+        already resident per device; the scan keeps its plain body."""
+        return None
+
     def comm_bytes_estimate(self, params, compute_dtype=None,
                             hints=None) -> dict:
         """Analytic per-step, per-device collective-traffic estimate for
@@ -813,6 +828,27 @@ class FullyShardedDataParallel(_HintedParallel):
             )
 
         return jax.tree_util.tree_map(pin, params)
+
+    def overlap_spec(self):
+        """FSDP's per-layer gather, made explicit for the scan's
+        double-buffered prefetch: pin every ndim>=1 leaf of a layer slice
+        to the fully replicated layout (``PartitionSpec()``) — exactly
+        the all-gather GSPMD would insert at first use, but issued where
+        the scan body says, one layer early. Values are untouched
+        (``with_sharding_constraint`` is layout-only and differentiable:
+        the backward re-shards the cotangent), so overlapped and plain
+        scans are numerically identical."""
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def gather(layer_params):
+            def pin(a):
+                if getattr(a, "ndim", 0) < 1:
+                    return a
+                return jax.lax.with_sharding_constraint(a, rep)
+
+            return jax.tree_util.tree_map(pin, layer_params)
+
+        return gather
 
     def comm_bytes_estimate(self, params, compute_dtype=None,
                             hints=None) -> dict:

@@ -272,7 +272,8 @@ class Engine:
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None, seed: int = 0,
                  prefix_cache: bool = False, kv_dtype=None,
-                 draft_model: Optional[Model] = None, spec_k: int = 4):
+                 draft_model: Optional[Model] = None, spec_k: int = 4,
+                 decode_kernel: str = "reference"):
         if not model.built:
             raise RuntimeError("Model not built")
         if prefill_chunk is not None and prefill_chunk < 1:
@@ -290,6 +291,21 @@ class Engine:
         self.top_k = top_k
         self.eos_id = eos_id
         self.seed = int(seed)
+        # Decode-kernel selection: 'reference' keeps the _paged_view +
+        # dense-attention path; 'fused' traces the decode and verify
+        # dispatches through the fused Pallas gather+attention kernel
+        # (ops.paged_attention — token-parity pinned in tests; the
+        # throughput claim is accelerator-only, docs/PERF.md). Prefill is
+        # chunk-parallel, not table-bound, and always uses the reference
+        # path.
+        from ..ops import paged_attention as paged_ops
+        if decode_kernel not in paged_ops.KINDS:
+            raise ValueError(
+                f"decode_kernel must be one of {paged_ops.KINDS}, got "
+                f"{decode_kernel!r}"
+            )
+        self.decode_kernel = decode_kernel
+        self._paged_ops = paged_ops
         # Served weights are an engine-owned SNAPSHOT of the model's
         # params/state, taken here and replaced only through
         # update_weights() — so a trainer sharing the model object in the
@@ -372,7 +388,9 @@ class Engine:
             donate_argnums=(2,),
         )
         self._prefill_fn = self.model._scoped(self._prefill_jit)
-        self._decode_fn = self.model._scoped(self._decode_jit)
+        self._decode_fn = self._with_kernel(
+            self.model._scoped(self._decode_jit)
+        )
         if draft_model is not None:
             # Target-side verify: K candidates per slot, one dispatch.
             self._verify_jit = jax.jit(
@@ -382,7 +400,9 @@ class Engine:
                 ),
                 donate_argnums=(2,),
             )
-            self._verify_fn = self.model._scoped(self._verify_jit)
+            self._verify_fn = self._with_kernel(
+                self.model._scoped(self._verify_jit)
+            )
             # Draft dispatches are GREEDY regardless of the engine's
             # sampling config: proposals are only hints — acceptance
             # compares them against the target's (possibly sampled)
@@ -405,11 +425,36 @@ class Engine:
             self._draft_prefill_fn = draft_model._scoped(
                 self._draft_prefill_jit
             )
-            self._draft_decode_fn = draft_model._scoped(
-                self._draft_decode_jit
+            self._draft_decode_fn = self._with_kernel(
+                draft_model._scoped(self._draft_decode_jit)
             )
+        events_lib.emit(
+            evs.DECODE_KERNEL_SELECTED,
+            kernel=self.decode_kernel,
+            backend=jax.default_backend(),
+            interpret=bool(jax.default_backend() != "tpu"),
+        )
         self.last_run_telemetry = None
         self._sched: Optional[Scheduler] = None  # live during run()
+
+    def _with_kernel(self, fn):
+        """Wrap a scoped decode/verify dispatch so its FIRST (tracing)
+        call — and every later one, harmlessly — runs inside the engine's
+        decode_kernel_scope: the attention layer reads the ambient choice
+        at trace time (ops.paged_attention.current_decode_kernel), so the
+        traced program bakes the kernel in. 'reference' returns ``fn``
+        unwrapped — byte-for-byte the pre-knob call path."""
+        if self.decode_kernel == self._paged_ops.REFERENCE:
+            return fn
+        kind = self.decode_kernel
+        scope = self._paged_ops.decode_kernel_scope
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with scope(kind):
+                return fn(*args, **kwargs)
+
+        return wrapped
 
     # ------------------------------------------------------- live signals
     @property

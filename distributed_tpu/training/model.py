@@ -1251,6 +1251,12 @@ class Model:
         # model._stall_timer). Summarized into last_fit_telemetry at exit.
         timer = StepTimer(warmup=0)
         self._stall_timer = timer
+        # Reset the thread's scanned-overlap trace record so this fit's
+        # telemetry can only see a record ITS OWN tracing wrote (a warm
+        # jit cache writes none — the report then under-claims rather
+        # than inherit another model's record).
+        from ..nn import scan as _nn_scan
+        _nn_scan._overlap_trace.record = None
         # Observability runtime (docs/OBSERVABILITY.md): per-dispatch
         # flight records + step-seconds ring, and a periodic cross-rank
         # metrics_snapshot flush over the supervisor's event-log
@@ -1639,6 +1645,40 @@ class Model:
             ),
             hints=self._param_hints,
         )
+        # Gather-overlap attribution (ScannedBlocks x Strategy.overlap_spec):
+        # the trace-time record of the most recent scanned apply on this
+        # thread says whether the double-buffered gather engaged.
+        # exposed_comm_fraction is the analytic share of per-layer gather
+        # traffic left serial with compute: all L gathers without overlap,
+        # only layer 0's warm-up gather with it. The span-attributed
+        # measurement lives in `bench.py overlap2`; this rides with every
+        # fit so telemetry names the lever (docs/PERF.md "Overlap round 2").
+        from ..nn.scan import last_overlap_trace
+        _otrace = last_overlap_trace()
+        if _otrace is None:
+            # Warm jit cache = nothing traced this fit; this model's own
+            # previous fit (if any) already recorded the program's shape.
+            _otrace = getattr(self, "_overlap_record", None)
+        else:
+            self._overlap_record = _otrace
+        _olayers = int(_otrace["layers"]) if _otrace else 0
+        _oactive = bool(_otrace and _otrace["active"])
+        report["overlap"] = {
+            "overlap": _oactive,
+            "exposed_comm_fraction": (
+                round(1.0 / _olayers, 6) if (_oactive and _olayers) else 1.0
+            ),
+            "layers": _olayers,
+        }
+        if obs_registry.enabled() and events_lib.default_log() is not None:
+            events_lib.emit(
+                evs.OVERLAP_REPORT,
+                overlap=report["overlap"]["overlap"],
+                exposed_comm_fraction=report["overlap"][
+                    "exposed_comm_fraction"],
+                layers=report["overlap"]["layers"],
+                strategy=type(self.strategy).__name__,
+            )
         # The auto-shard decision record rides with every fit it governed:
         # chosen config, predicted bytes/traffic, and the pruned
         # candidates' rationale (docs/PERF.md "Autotuned sharding").

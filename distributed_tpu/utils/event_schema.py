@@ -79,6 +79,11 @@ STREAM_OPEN = "stream_open"
 QUOTA_REJECT = "quota_reject"
 TRANSPORT_FALLBACK = "transport_fallback"
 
+# Raw-speed levers (training/model.py fit telemetry, serving/engine.py
+# startup).
+OVERLAP_REPORT = "overlap_report"
+DECODE_KERNEL_SELECTED = "decode_kernel_selected"
+
 
 # -------------------------------------------------------------- schema
 # required: keys every emit site must pass literally (consumers index
@@ -240,6 +245,20 @@ EVENTS: Dict[str, dict] = {
         "required": ("request_id",),
         "optional": ("reason", "replica"),
     },
+    # Per-FIT aggregate: whether the scanned-stack gather overlap engaged
+    # and the fraction of per-layer gather traffic left exposed (serial
+    # with compute) — 1.0 without overlap, 1/layers with it (only the
+    # first layer's warm-up gather has nothing to hide behind).
+    OVERLAP_REPORT: {
+        "required": ("overlap", "exposed_comm_fraction"),
+        "optional": ("layers", "strategy"),
+    },
+    # Once per Engine construction — which decode kernel the jitted
+    # dispatches will trace through.
+    DECODE_KERNEL_SELECTED: {
+        "required": ("kernel",),
+        "optional": ("backend", "interpret"),
+    },
 }
 
 
@@ -268,5 +287,5 @@ __all__ = [
     "METRICS_SNAPSHOT", "AUTO_SHARD_PLAN", "FLEET_REPLICA_KILLED",
     "PREFIX_CACHE_HIT", "PREFIX_EVICT", "SPEC_VERIFY",
     "SERVICE_START", "REPLICA_SPAWN", "STREAM_OPEN", "QUOTA_REJECT",
-    "TRANSPORT_FALLBACK",
+    "TRANSPORT_FALLBACK", "OVERLAP_REPORT", "DECODE_KERNEL_SELECTED",
 ]

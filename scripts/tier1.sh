@@ -196,6 +196,21 @@ if [ -n "${TIER1_SERVICE_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_KERNEL_SMOKE=1: same idea for the raw-speed round-2 tier — runs
+# the fused paged-attention kernel parity matrix + engine token-exact
+# tests, the FSDP gather-overlap tests, and the bench overlap2 smoke
+# (~60 s) so decode-kernel/overlap changes iterate fast. The measured
+# artifacts come from `python bench.py overlap2 decode_kernel`
+# (BENCH_overlap2.json / BENCH_decode_kernel.json; docs/PERF.md "Overlap
+# round 2" / "Fused paged attention"). NOT a tier-1 substitute.
+if [ -n "${TIER1_KERNEL_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_paged_kernel.py \
+        tests/test_fsdp_overlap.py \
+        "tests/test_bench.py::test_bench_overlap2_smoke" \
+        -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

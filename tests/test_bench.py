@@ -537,3 +537,52 @@ def test_bench_longctx_smoke():
     # 4-tuple config: chunked head-loss rides the same harness.
     assert row3["metric"] == "lm_longctx_b8_t64_remat_hc2"
     assert row3["value"] > 0
+
+
+def test_bench_overlap2_smoke():
+    """The FSDP gather-prefetch mode at tiny shapes: trajectory-parity
+    assert, the structural exposed-comm drop, and the span-attributed
+    comm seconds all run on the 8-device sim — the real artifact comes
+    from `python bench.py overlap2` (BENCH_overlap2.json)."""
+    out = bench.bench_overlap2(vocab=64, num_layers=2, d_model=16,
+                               seq_len=16, batch=8, steps=3,
+                               gather_reps=2, windows=1)
+    assert out["unit"] == "exposed_comm_fraction"
+    assert out["overlap_active"] is True
+    assert out["value"] < out["baseline_off_fraction"] == 1.0
+    assert out["value"] == pytest.approx(1.0 / out["layers"])
+    assert out["loss_parity"]["allclose"] is True
+    assert out["loss_parity"]["rtol"] == 2e-5
+    assert out["backend"] == "cpu" and out["speedup_asserted"] is False
+    spans = out["span_seconds"]
+    assert spans["gather_prefetch_per_dispatch"] > 0
+    assert spans["compute_per_step"] > 0
+    # The timed gather program contains REAL all-gathers (GSPMD would
+    # cancel an unconsumed gather; out_shardings pin it).
+    assert spans["all_gathers_in_timed_program"] > 0
+    assert spans["paths"] == [
+        "span_seconds/fit/dispatch/gather_prefetch",
+        "span_seconds/fit/dispatch/compute",
+    ]
+
+
+# @slow (tier-1 budget): every serving config compiles two engines; the
+# in-tier kernel/engine parity coverage lives in test_paged_kernel.py and
+# the real artifact comes from `python bench.py decode_kernel`.
+@pytest.mark.slow
+def test_bench_decode_kernel_smoke():
+    out = bench.bench_decode_kernel(num_requests=4, max_slots=2,
+                                    repeats=1)
+    assert out["unit"] == "tokens/s" and out["value"] > 0
+    assert out["token_exact_all_configs"] is True
+    assert out["backend"] == "cpu" and out["speedup_asserted"] is False
+    names = [r["config"] for r in out["configs"]]
+    assert names == ["greedy_churn", "sampled_seeded", "preemption",
+                     "prefix_cache", "int8_kv", "spec_verify"]
+    for row in out["configs"]:
+        assert row["token_exact"] is True
+        assert row["reference_tokens_per_sec"] > 0
+        assert row["fused_tokens_per_sec"] > 0
+    preempt_row = next(r for r in out["configs"]
+                       if r["config"] == "preemption")
+    assert preempt_row["preemptions"] > 0
