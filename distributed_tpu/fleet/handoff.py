@@ -55,6 +55,15 @@ class HandoffIncompatible(ValueError):
     or layer-structure mismatch) — the caller must re-prefill instead."""
 
 
+def _block_axis(path: str) -> int:
+    """Pool-block axis for the leaf at ``path``: 0 for ordinary per-layer
+    pools, 1 for stacked-block pools — a ``stacked`` path segment is the
+    ``nn.scan.STACKED_POOL_KEY`` contract marking leaves whose LEADING dim
+    is the block-stack (ScannedBlocks / PipelinedBlocks), with pool blocks
+    on axis 1. Gathers/scatters and the logical-start key index follow it."""
+    return 1 if "stacked" in path.split("/") else 0
+
+
 def _cache_leaves(caches):
     """(path, leaf) pairs of the paged pools in checkpoint path order,
     plus the flatten structure for rebuilds. iter_leaf_paths (sorted dict
@@ -123,7 +132,10 @@ def pack_kv(kv, slot: int, cached_len: int, tokens=None) -> KVHandoff:
     blocks = {}
     dtype = None
     for path, pool in zip(paths, leaves):
-        data = np.asarray(jax.device_get(pool[ids]))
+        ax = _block_axis(path)
+        data = np.asarray(jax.device_get(
+            pool[ids] if ax == 0 else pool[:, ids]
+        ))
         dtype = str(pool.dtype)
         blocks[_block_key(path, (0,) * data.ndim, data.shape)] = data
     hashes = ()
@@ -161,8 +173,10 @@ def install_kv(kv, slot: int, payload: KVHandoff):
     by_path: Dict[str, list] = {}
     for key, data in payload.blocks.items():
         path, starts, _shape = _parse_key(key)
-        by_path.setdefault(path, []).append((starts[0] if starts else 0,
-                                             data))
+        ax = _block_axis(path)
+        by_path.setdefault(path, []).append(
+            (starts[ax] if len(starts) > ax else 0, data)
+        )
     if set(by_path) != set(paths):
         raise HandoffIncompatible(
             "layer structure mismatch between prefill and decode pools "
@@ -172,6 +186,7 @@ def install_kv(kv, slot: int, payload: KVHandoff):
     installed = 0
     new_leaves = []
     for path, pool in zip(paths, leaves):
+        ax = _block_axis(path)
         for start, data in sorted(by_path[path]):
             # Per-LEAF dtype gate: an int8 pool's leaves are int8 ``q``
             # plus float32 ``scale`` — each shipped run must match its
@@ -181,11 +196,13 @@ def install_kv(kv, slot: int, payload: KVHandoff):
                     f"dtype mismatch on {path}: payload {data.dtype} vs "
                     f"pool {pool.dtype}"
                 )
-            run = np.asarray(ids[start:start + data.shape[0]], np.int32)
-            pool = pool.at[jnp.asarray(run)].set(
-                jnp.asarray(data, pool.dtype)
-            )
-            installed += int(data.shape[0])
+            run = np.asarray(ids[start:start + data.shape[ax]], np.int32)
+            idx = jnp.asarray(run)
+            if ax == 0:
+                pool = pool.at[idx].set(jnp.asarray(data, pool.dtype))
+            else:
+                pool = pool.at[:, idx].set(jnp.asarray(data, pool.dtype))
+            installed += int(data.shape[ax])
         new_leaves.append(pool)
     kv.caches = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return installed
@@ -216,12 +233,15 @@ def trim_kv(payload: KVHandoff, store) -> Tuple[KVHandoff, int]:
     blocks: Dict[str, np.ndarray] = {}
     for key, data in payload.blocks.items():
         path, starts, _shape = _parse_key(key)
-        first = starts[0] if starts else 0
-        if data.shape[0] + first <= skip:
+        ax = _block_axis(path)
+        first = starts[ax] if len(starts) > ax else 0
+        if data.shape[ax] + first <= skip:
             continue  # this run is entirely inside the cached prefix
         keep = max(skip - first, 0)
-        rest = data[keep:]
-        new_starts = (first + keep,) + tuple(starts[1:])
+        rest = data[keep:] if ax == 0 else data[:, keep:]
+        new_starts = tuple(
+            s + keep if i == ax else s for i, s in enumerate(starts)
+        )
         blocks[_block_key(path, new_starts, rest.shape)] = rest
     trimmed = dataclasses.replace(
         payload, blocks=blocks, skip_blocks=int(skip)

@@ -68,6 +68,8 @@ def transformer_lm(
     moe_experts: int = 0,
     moe_every: int = 2,
     pipeline: bool = False,
+    pipeline_schedule: str = "gpipe",
+    pipeline_interleave: int = 1,
     scan: bool = False,
     scan_overlap: str = "auto",
     remat: bool = False,
@@ -84,6 +86,10 @@ def transformer_lm(
     pipeline over the 'pipe' mesh axis under ``DataPipelineParallel`` (and
     run as a weight-stacked scan otherwise); incompatible with MoE blocks
     (aux-loss state can't ride the microbatch schedule).
+    ``pipeline_schedule``/``pipeline_interleave`` forward
+    ``nn.PipelinedBlocks(schedule=, interleave=)`` — ``"interleaved"``
+    with ``interleave=v`` gives each pipe rank ``v`` non-contiguous stage
+    chunks, shrinking the bubble from (n-1)/(M+n-1) to (n-1)/(vM+n-1).
     ``scan=True`` stacks them in an ``nn.ScannedBlocks`` — one lax.scan over
     weight-stacked blocks, keeping static op count and compile time
     depth-independent; generation works through stacked KV caches
@@ -121,7 +127,10 @@ def transformer_lm(
             return nn.Remat(block, policy=remat_policy) if remat else block
 
         if pipeline:
-            layers.append(nn.PipelinedBlocks(make_block, num_layers))
+            layers.append(nn.PipelinedBlocks(
+                make_block, num_layers,
+                schedule=pipeline_schedule, interleave=pipeline_interleave,
+            ))
         else:
             layers.append(nn.ScannedBlocks(
                 make_block, num_layers, overlap=scan_overlap,

@@ -22,7 +22,12 @@ TPU-first design:
   train step yields the reverse pipeline schedule without any hand-written
   backward pass.
 - Bubble fraction is the standard GPipe (n-1)/(M+n-1); raise
-  ``num_microbatches`` on the strategy to amortize.
+  ``num_microbatches`` on the strategy to amortize — or switch to
+  ``schedule="interleaved"``: each rank holds ``v`` non-contiguous chunks of
+  the stack (Megatron's virtual stages; Narayanan et al., 2021) and the tick
+  scan circulates every microbatch ``v`` laps around the full ring, cutting
+  the bubble to (n-1)/(vM+n-1) — the same n-1 idle ticks amortized over v
+  laps of useful ones, each tick now 1/v of a GPipe stage's compute.
 
 Single-device (no 'pipe' axis in the ambient strategy) the same layer runs
 its blocks as a weight-stacked ``lax.scan`` — one trace of the block instead
@@ -31,10 +36,12 @@ of S inlined copies, which keeps compile time flat in depth.
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec
 
@@ -55,6 +62,22 @@ elif "check_rep" in _sig:  # pragma: no cover — older jax
 else:  # pragma: no cover
     _CHECK_KWARGS = {}
 del _sig
+
+
+# Trace-time record of the most recent pipelined apply on this thread:
+# which schedule ran, over how many stages/microbatches/ticks, and the
+# resulting bubble fraction. Model.fit's telemetry exit reads it
+# (training/model.py) the same way it reads scan.last_overlap_trace —
+# best-effort by design, like the threadlocal strategy scope it mirrors.
+_pipeline_trace = threading.local()
+
+
+def last_pipeline_trace() -> Optional[dict]:
+    """``{"schedule", "interleave", "num_stages", "num_microbatches",
+    "ticks", "bubble_fraction"}`` from the most recent pipelined apply
+    traced on this thread, or None before any (including the sequential
+    single-device path, which has no schedule to report)."""
+    return getattr(_pipeline_trace, "record", None)
 
 
 def _live_pipe_mesh(strategy):
@@ -111,12 +134,37 @@ class PipelinedBlocks(Layer):
         block_fn: Callable[[], Layer],
         num_blocks: int,
         *,
+        schedule: str = "gpipe",
+        interleave: int = 1,
         name: Optional[str] = None,
     ):
+        """``schedule``: 'gpipe' (default) runs each rank's contiguous
+        stage once per microbatch; 'interleaved' splits each rank's stage
+        into ``interleave`` virtual chunks and circulates every microbatch
+        that many laps around the ring (module docstring) — same numerics,
+        smaller bubble, needs ``num_microbatches >= stages`` and
+        ``num_blocks % (stages * interleave) == 0``."""
         super().__init__(name)
         if num_blocks < 1:
             raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if schedule not in ("gpipe", "interleaved"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or 'interleaved', got {schedule!r}"
+            )
+        v = int(interleave)
+        if schedule == "gpipe" and v != 1:
+            raise ValueError(
+                "interleave only applies to schedule='interleaved' "
+                f"(got interleave={v} with schedule='gpipe')"
+            )
+        if schedule == "interleaved" and v < 2:
+            raise ValueError(
+                "schedule='interleaved' needs interleave >= 2 "
+                f"(interleave=1 IS the GPipe schedule), got {v}"
+            )
         self.num_blocks = int(num_blocks)
+        self.schedule = schedule
+        self.interleave = v
         self.block_fn = block_fn
         self.block = block_fn()  # template: defines structure + names
 
@@ -167,6 +215,7 @@ class PipelinedBlocks(Layer):
         return out
 
     def apply(self, params, state, x, *, train=False, rng=None):
+        from ..obs import spans as obs_spans
         from ..parallel.strategy import current_strategy
 
         stacked = params["blocks"]
@@ -177,10 +226,12 @@ class PipelinedBlocks(Layer):
             return self._scan_blocks(stacked, x, train=train, rngs=rngs), {}
 
         n = int(mesh.shape[pipe_axis])
-        if self.num_blocks % n:
+        v = self.interleave
+        if self.num_blocks % (n * v):
             raise ValueError(
                 f"{self.num_blocks} blocks not divisible by "
                 f"{pipe_axis}={n} stages"
+                + (f" x interleave={v} virtual chunks" if v > 1 else "")
             )
         # Batch rows may shard over several axes (CompositeParallel rows
         # over ('data','fsdp')); honor them all so the schedule's shard_map
@@ -200,11 +251,44 @@ class PipelinedBlocks(Layer):
                 f"batch {b_global} not divisible by data shards ({n_data}) "
                 f"x microbatches ({m})"
             )
+        if v > 1 and m < n:
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches >= stages "
+                f"(got M={m} < n={n}): a microbatch re-enters rank 0 for "
+                f"its next lap M-n ticks after it left, which must not be "
+                f"in the past"
+            )
         b_local = b_global // n_data
         mb = b_local // m
+        ticks = v * m + n - 1
+        _pipeline_trace.record = {
+            "schedule": self.schedule,
+            "interleave": v,
+            "num_stages": n,
+            "num_microbatches": m,
+            "ticks": ticks,
+            "bubble_fraction": round((n - 1) / ticks, 6),
+        }
         feat_none = (None,) * (x.ndim - 1)
         rows = row_axes if len(row_axes) > 1 else row_axes[0]
         x_spec = PartitionSpec(rows, *feat_none)
+        if v > 1:
+            # Static reindex for the virtual-stage layout: rank r's
+            # contiguous pipe shard, read as v sub-chunks of cs blocks,
+            # must hold original chunks j*n + r for laps j = 0..v-1 (each
+            # lap advances the microbatch one chunk on every rank, and a
+            # full ring pass advances it n chunks). The stacked leading
+            # dim stays one pytree; only the block order changes, and the
+            # perm is a compile-time constant, so XLA lays the shuffle
+            # into the weights' placement rather than a per-tick gather.
+            cs = self.num_blocks // (n * v)
+            perm = np.concatenate([
+                np.arange((j * n + r) * cs, (j * n + r + 1) * cs)
+                for r in range(n) for j in range(v)
+            ])
+            stacked = jax.tree_util.tree_map(lambda l: l[perm], stacked)
+            if rngs is not None:
+                rngs = rngs[perm]
         p_specs = jax.tree_util.tree_map(_stage_spec(pipe_axis), stacked)
         in_specs = [p_specs, x_spec]
         args = [stacked, x]
@@ -214,7 +298,7 @@ class PipelinedBlocks(Layer):
 
         scan_blocks = self._scan_blocks
 
-        def local_fn(p_local, x_local, *maybe_rngs):
+        def gpipe_fn(p_local, x_local, *maybe_rngs):
             r_local = maybe_rngs[0] if maybe_rngs else None
             rank = lax.axis_index(pipe_axis)
             mbs = x_local.reshape((m, mb) + x_local.shape[1:])
@@ -247,13 +331,80 @@ class PipelinedBlocks(Layer):
                 pipe_axis,
             )
 
-        out = shard_map(
-            local_fn,
-            mesh=mesh,
-            in_specs=tuple(in_specs),
-            out_specs=x_spec,
-            **_CHECK_KWARGS,
-        )(*args)
+        def interleaved_fn(p_local, x_local, *maybe_rngs):
+            # v laps over the FULL ring (rank n-1 wraps to rank 0). At
+            # tick t rank r runs lap j = (t-r)//M on microbatch (t-r)%M
+            # using its j-th resident chunk; a microbatch leaves rank n-1
+            # at lap j and re-enters rank 0 for lap j+1 exactly M-n ticks
+            # later, so rank 0 banks every wrap-around arrival in an
+            # (M, mb, ...) buffer keyed by microbatch index (M >= n makes
+            # the write land no later than the tick that reads it; ticks
+            # outside a rank's active window compute on garbage that the
+            # bubble discards, same as GPipe's clamped injections).
+            r_local = maybe_rngs[0] if maybe_rngs else None
+            rank = lax.axis_index(pipe_axis)
+            mbs = x_local.reshape((m, mb) + x_local.shape[1:])
+            ring = [(j, (j + 1) % n) for j in range(n)]
+
+            def tick(carry, t):
+                recv, buf = carry
+                # Incoming recv at tick t is rank n-1's tick t-1 output:
+                # microbatch (t-n) mod M, banked for its next lap.
+                buf = lax.dynamic_update_index_in_dim(
+                    buf, recv, jnp.mod(t - n, m), axis=0
+                )
+                u = t - rank
+                lap = jnp.clip(u // m, 0, v - 1)
+                mbi = jnp.mod(u, m)
+                inj = lax.dynamic_index_in_dim(
+                    mbs, mbi, axis=0, keepdims=False
+                )
+                re_entry = lax.dynamic_index_in_dim(
+                    buf, mbi, axis=0, keepdims=False
+                )
+                h = jnp.where(
+                    rank == 0, jnp.where(lap == 0, inj, re_entry), recv
+                )
+                chunk = jax.tree_util.tree_map(
+                    lambda l: lax.dynamic_slice_in_dim(
+                        l, lap * cs, cs, axis=0
+                    ),
+                    p_local,
+                )
+                rngs_t = (
+                    None if r_local is None
+                    else jax.vmap(jax.random.fold_in, (0, None))(
+                        lax.dynamic_slice_in_dim(
+                            r_local, lap * cs, cs, axis=0
+                        ),
+                        t,
+                    )
+                )
+                y = scan_blocks(chunk, h, train=train, rngs=rngs_t)
+                return (lax.ppermute(y, pipe_axis, ring), buf), y
+
+            zeros = jnp.zeros((mb,) + x_local.shape[1:], x_local.dtype)
+            buf0 = jnp.zeros((m, mb) + x_local.shape[1:], x_local.dtype)
+            (_, _), ys = lax.scan(tick, (zeros, buf0), jnp.arange(ticks))
+            # Rank n-1's final-lap ticks (v-1)M+n-1 .. vM+n-2 hold
+            # microbatch outputs 0..M-1 in order.
+            outs = ys[(v - 1) * m + n - 1:].reshape(
+                (b_local,) + x_local.shape[1:]
+            )
+            return lax.psum(
+                jnp.where(rank == n - 1, outs, jnp.zeros_like(outs)),
+                pipe_axis,
+            )
+
+        local_fn = interleaved_fn if v > 1 else gpipe_fn
+        with obs_spans.span("pipeline_schedule"):
+            out = shard_map(
+                local_fn,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=x_spec,
+                **_CHECK_KWARGS,
+            )(*args)
         return out, {}
 
     # ---------------------------------------------------- incremental decode
@@ -265,19 +416,65 @@ class PipelinedBlocks(Layer):
             dtype,
         )
 
+    # Paged (block KV) serving works on the sequential single-device path:
+    # the pools stack with a leading (S, ...) stage dim (scan.py's
+    # stacked-pool layout) and each hook scans the template block's paged
+    # step over the stack. On a LIVE pipe mesh it stays a loud raise: the
+    # serving engine's block allocator, prefix store, and copy-on-write
+    # are host-side state over ONE pool address space, and a pipe-sharded
+    # stack would give every rank a different pool — serve off the pipe
+    # mesh (where PP's memory argument doesn't apply: decode holds one
+    # token of activations, not a training batch).
+    def _no_paged_on_pipe_mesh(self):
+        from ..parallel.strategy import current_strategy
+
+        mesh, pipe_axis = _live_pipe_mesh(current_strategy())
+        if mesh is not None:
+            raise NotImplementedError(
+                "PipelinedBlocks paged serving is single-device only: the "
+                "paged pool's allocator/prefix/copy-on-write state is "
+                "host-side and assumes one pool address space, which a "
+                f"{pipe_axis}-sharded stack would split across ranks — "
+                "serve this model OFF the pipe mesh (the sequential path "
+                "supports the full paged engine)"
+            )
+
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        from .scan import stacked_init_paged_cache
+
+        self._no_paged_on_pipe_mesh()
+        return stacked_init_paged_cache(
+            self.block, self.num_blocks, params["blocks"], num_blocks,
+            block_size, dtype,
+        )
+
     def paged_decode(self, params, state, cache, x, *, block_tables,
                      positions):
-        raise NotImplementedError(
-            "PipelinedBlocks does not support the paged (block) KV cache "
-            "yet — serve unstacked transformer_lm(pipeline=False) models, "
-            "or use Model.generate() (dense cache) for pipelined stacks"
+        from .scan import stacked_paged_decode
+
+        self._no_paged_on_pipe_mesh()
+        return stacked_paged_decode(
+            self.block, params["blocks"], {}, cache, x,
+            block_tables=block_tables, positions=positions,
+        )
+
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        from .scan import stacked_paged_verify
+
+        self._no_paged_on_pipe_mesh()
+        return stacked_paged_verify(
+            self.block, params["blocks"], {}, cache, x,
+            block_tables=block_tables, positions=positions,
         )
 
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
-        raise NotImplementedError(
-            "PipelinedBlocks does not support the paged (block) KV cache "
-            "yet — serve unstacked transformer_lm(pipeline=False) models, "
-            "or use Model.generate() (dense cache) for pipelined stacks"
+        from .scan import stacked_paged_prefill
+
+        self._no_paged_on_pipe_mesh()
+        return stacked_paged_prefill(
+            self.block, params["blocks"], {}, cache, x,
+            block_table=block_table, start=start,
         )
 
     def decode(self, params, state, cache, x, *, pos):

@@ -187,6 +187,78 @@ def stacked_decode(block, stacked_p, stacked_s, cache, x, *, pos):
     return out, cache
 
 
+# The reserved key the stacked PAGED pools live under. serving/kv_cache's
+# pool walkers key on it: leaves below carry a leading (S, ...) stage dim,
+# so the pool-block axis is 1, not 0 (copy-on-write and per-block byte
+# accounting must index/skip accordingly). A dict key (not a wrapper type)
+# keeps the pools an ordinary pytree for jit/donation.
+STACKED_POOL_KEY = "stacked"
+
+
+def stacked_init_paged_cache(block, num_blocks, stacked_p, pool_blocks,
+                             block_size, dtype):
+    """Stacked (S, ...) paged pools for a block stack, under
+    ``STACKED_POOL_KEY`` — shared by ScannedBlocks and PipelinedBlocks'
+    sequential path so the layout can't diverge. Broadcasts the template's
+    pools (same rationale as ``stacked_init_cache``)."""
+    p0 = jax.tree_util.tree_map(lambda l: l[0], stacked_p)
+    c0 = block.init_paged_cache(p0, pool_blocks, block_size, dtype)
+    if not jax.tree_util.tree_leaves(c0):
+        return {}
+    return {
+        STACKED_POOL_KEY: jax.tree_util.tree_map(
+            lambda l: jnp.broadcast_to(l, (num_blocks,) + l.shape).copy(),
+            c0,
+        )
+    }
+
+
+def _stacked_paged_step(step_name, block, stacked_p, stacked_s, cache, x,
+                        **kw):
+    """Scan one of the template block's paged hooks over the stacked
+    (params, state, pools). The block tables and per-slot positions are
+    closed over — every layer of every block addresses the same tables,
+    exactly as the unrolled Sequential's per-layer pools do — and each
+    block's paged step reads/writes only its own (S,) slice of the pools."""
+    step = getattr(block, step_name)
+
+    def body(h, per_block):
+        p, s, c = per_block
+        y, new_c = step(p, s, c, h, **kw)
+        return y.astype(h.dtype), new_c
+
+    out, new_cache = lax.scan(
+        body, x, (stacked_p, stacked_s, cache.get(STACKED_POOL_KEY, {}))
+    )
+    if jax.tree_util.tree_leaves(new_cache):
+        return out, {STACKED_POOL_KEY: new_cache}
+    return out, cache
+
+
+def stacked_paged_decode(block, stacked_p, stacked_s, cache, x, *,
+                         block_tables, positions):
+    return _stacked_paged_step(
+        "paged_decode", block, stacked_p, stacked_s, cache, x,
+        block_tables=block_tables, positions=positions,
+    )
+
+
+def stacked_paged_verify(block, stacked_p, stacked_s, cache, x, *,
+                         block_tables, positions):
+    return _stacked_paged_step(
+        "paged_verify", block, stacked_p, stacked_s, cache, x,
+        block_tables=block_tables, positions=positions,
+    )
+
+
+def stacked_paged_prefill(block, stacked_p, stacked_s, cache, x, *,
+                          block_table, start):
+    return _stacked_paged_step(
+        "paged_prefill", block, stacked_p, stacked_s, cache, x,
+        block_table=block_table, start=start,
+    )
+
+
 class ScannedBlocks(Layer):
     """S structurally identical, shape-preserving blocks run as one scan.
 
@@ -329,20 +401,34 @@ class ScannedBlocks(Layer):
             pos=pos,
         )
 
+    # Paged (block KV) serving: the per-layer pools stack with a leading
+    # (S, ...) stage dim like everything else in this module, and each
+    # hook scans the template block's paged step over the stack with the
+    # block tables / per-slot position vectors closed over. The serving
+    # engine's allocator and prefix store see block indices on axis 1
+    # (the STACKED_POOL_KEY contract in serving/kv_cache.py).
+    def init_paged_cache(self, params, num_blocks, block_size, dtype):
+        return stacked_init_paged_cache(
+            self.block, self.num_blocks, params["blocks"], num_blocks,
+            block_size, dtype,
+        )
+
     def paged_decode(self, params, state, cache, x, *, block_tables,
                      positions):
-        # Inheriting the default (which routes through decode() with a
-        # VECTOR of per-slot positions) would die deep inside the scanned
-        # one-token step with an opaque shape error; fail loudly instead.
-        raise NotImplementedError(
-            "ScannedBlocks does not support the paged (block) KV cache yet "
-            "— serve unstacked transformer_lm(scan=False) models, or use "
-            "Model.generate() (dense cache) for scanned stacks"
+        return stacked_paged_decode(
+            self.block, params["blocks"], state.get("blocks", {}), cache, x,
+            block_tables=block_tables, positions=positions,
+        )
+
+    def paged_verify(self, params, state, cache, x, *, block_tables,
+                     positions):
+        return stacked_paged_verify(
+            self.block, params["blocks"], state.get("blocks", {}), cache, x,
+            block_tables=block_tables, positions=positions,
         )
 
     def paged_prefill(self, params, state, cache, x, *, block_table, start):
-        raise NotImplementedError(
-            "ScannedBlocks does not support the paged (block) KV cache yet "
-            "— serve unstacked transformer_lm(scan=False) models, or use "
-            "Model.generate() (dense cache) for scanned stacks"
+        return stacked_paged_prefill(
+            self.block, params["blocks"], state.get("blocks", {}), cache, x,
+            block_table=block_table, start=start,
         )

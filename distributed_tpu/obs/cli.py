@@ -107,6 +107,16 @@ def summarize(events: List[dict], flight_paths=(),
         "rank_skew": aggregate.skew_report(events),
         "straggler": aggregate.straggler(events, straggler_threshold),
         "straggler_events": [e for e in events if e["event"] == evs.STRAGGLER],
+        # Last-writer-wins: one schedule/bubble row per postmortem (each
+        # fit re-emits; the latest reflects the run that ended the log).
+        "pipeline_schedule": next(
+            (e for e in reversed(events)
+             if e["event"] == evs.PIPELINE_SCHEDULE_SELECTED), None
+        ),
+        "bubble": next(
+            (e for e in reversed(events)
+             if e["event"] == evs.BUBBLE_REPORT), None
+        ),
         "flight_dumps": dumps,
     }
 
@@ -157,6 +167,20 @@ def render(summary: dict, *, tail: int = 10) -> str:
                 f"    rank {row['rank']}: median {row['median_step_s']}s "
                 f"(x{row['skew']}, {row['samples']} samples)"
             )
+    sched = summary.get("pipeline_schedule")
+    if sched is not None:
+        lines.append(
+            f"  pipeline schedule: {sched.get('schedule')} "
+            f"(interleave={sched.get('interleave')}, "
+            f"stages={sched.get('num_stages')}, "
+            f"microbatches={sched.get('num_microbatches')})"
+        )
+    bub = summary.get("bubble")
+    if bub is not None:
+        lines.append(
+            f"  pipeline bubble: {bub.get('bubble_fraction')} idle "
+            f"over {bub.get('ticks')} ticks"
+        )
     strag = summary["straggler"] or next(
         iter(summary["straggler_events"]), None
     )

@@ -162,7 +162,7 @@ _BACKEND_CONSTANTS = {
 }
 
 _STRATEGY_RANK = {  # simplicity order for tie-breaking (lower = simpler)
-    "single_device": 0, "dp": 1, "zero1": 2, "fsdp": 3, "tp": 4,
+    "single_device": 0, "dp": 1, "zero1": 2, "fsdp": 3, "tp": 4, "pp": 5,
 }
 
 
@@ -175,8 +175,10 @@ def _backend_constants(backend: Optional[str] = None) -> dict:
 class Candidate:
     """One point of the configuration matrix the planner scores."""
 
-    strategy: str                      # single_device | dp | zero1 | fsdp | tp
+    strategy: str                # single_device | dp | zero1 | fsdp | tp | pp
     model_parallel: int = 1            # > 1 only for strategy == "tp"
+    pipeline_parallel: int = 1         # > 1 only for strategy == "pp"
+    num_microbatches: int = 1          # pipeline schedule M (pp only)
     precision: Optional[str] = None    # None | precision preset name
     grad_accum: int = 1
     steps_per_execution: int = 1
@@ -185,6 +187,9 @@ class Candidate:
         parts = [self.strategy]
         if self.model_parallel > 1:
             parts[-1] += f"{self.model_parallel}"
+        if self.pipeline_parallel > 1:
+            parts[-1] += f"{self.pipeline_parallel}"
+            parts.append(f"m{self.num_microbatches}")
         if self.precision:
             parts.append(self.precision)
         if self.grad_accum > 1:
@@ -197,6 +202,8 @@ class Candidate:
         return {
             "strategy": self.strategy,
             "model_parallel": self.model_parallel,
+            "pipeline_parallel": self.pipeline_parallel,
+            "num_microbatches": self.num_microbatches,
             "precision": self.precision,
             "grad_accum": self.grad_accum,
             "steps_per_execution": self.steps_per_execution,
@@ -207,6 +214,8 @@ class Candidate:
         return (
             _STRATEGY_RANK.get(self.strategy, 99),
             self.model_parallel,
+            self.pipeline_parallel,
+            self.num_microbatches,
             0 if self.precision is None else 1,
             self.grad_accum,
             self.steps_per_execution,
@@ -229,6 +238,11 @@ class Candidate:
         if self.strategy == "tp":
             return S.DataTensorParallel(
                 devices, model_parallel=self.model_parallel
+            )
+        if self.strategy == "pp":
+            return S.DataPipelineParallel(
+                devices, pipeline_parallel=self.pipeline_parallel,
+                num_microbatches=self.num_microbatches,
             )
         raise ValueError(f"unknown candidate strategy {self.strategy!r}")
 
@@ -360,14 +374,17 @@ def _attach_shardings(tree, sharding_tree):
 # -------------------------------------------------------------- estimation --
 def _check_divisibility(cand: Candidate, n_devices: int, batch_size: int,
                         abstracts: dict) -> Optional[str]:
-    """Structural feasibility: batch math and TP shard divisibility.
+    """Structural feasibility: batch math and TP/PP shard divisibility.
     Returns a pruning reason or None."""
     if cand.strategy != "single_device" and n_devices % cand.model_parallel:
         return (f"{n_devices} devices not divisible by model_parallel="
                 f"{cand.model_parallel}")
+    if n_devices % cand.pipeline_parallel:
+        return (f"{n_devices} devices not divisible by pipeline_parallel="
+                f"{cand.pipeline_parallel}")
     replicas = (
         1 if cand.strategy == "single_device"
-        else n_devices // cand.model_parallel
+        else n_devices // (cand.model_parallel * cand.pipeline_parallel)
     )
     if batch_size % cand.grad_accum:
         return (f"grad_accum={cand.grad_accum} does not divide the global "
@@ -381,7 +398,39 @@ def _check_divisibility(cand: Candidate, n_devices: int, batch_size: int,
         if bad:
             return (f"TP shard dim {bad[1]} of {bad[0]} not divisible by "
                     f"model_parallel={m}")
+    if cand.strategy == "pp":
+        pp = cand.pipeline_parallel
+        stages = _pipe_stage_count(abstracts["params"], abstracts["hints"])
+        if stages is None:
+            return "no 'pipe'-hinted stacks to place stages from"
+        if stages % pp:
+            return (f"{stages} pipeline stages not divisible by "
+                    f"pipeline_parallel={pp}")
+        per_replica = micro // max(replicas, 1)
+        if per_replica % cand.num_microbatches:
+            return (f"per-replica batch {per_replica} not divisible by "
+                    f"num_microbatches={cand.num_microbatches}")
     return None
+
+
+def _pipe_stage_count(params, hints) -> Optional[int]:
+    """Leading (stage) dim of the first 'pipe'-hinted leaf — the number of
+    schedulable stages a PipelinedBlocks stack exposes. None when nothing
+    is pipe-hinted (the module has no pipeline stack to place)."""
+
+    def walk(p, h):
+        if isinstance(p, dict):
+            for k, v in p.items():
+                hit = walk(v, h.get(k, {}) if isinstance(h, dict) else h)
+                if hit is not None:
+                    return hit
+            return None
+        shape = tuple(getattr(p, "shape", ()))
+        if h == "pipe" and shape:
+            return int(shape[0])
+        return None
+
+    return walk(params, hints or {})
 
 
 def _tp_indivisible(params, hints, m: int):
@@ -477,6 +526,8 @@ def estimate_candidate(cand: Candidate, ctx: dict) -> dict:
         comm["gathered_param_bytes_per_device"] * cand.grad_accum
         + comm["grad_reduce_bytes_per_device"]
         + comm["activation_reduce_bytes_per_token_per_device"] * tokens_local
+        + comm.get("pipeline_hop_bytes_per_token_per_device", 0)
+        * tokens_local
     )
 
     flops = 6.0 * abstracts["n_params"] * tokens
@@ -492,6 +543,14 @@ def estimate_candidate(cand: Candidate, ctx: dict) -> dict:
         # that fits, not the maximum available. Priced as a +15% compute
         # penalty per doubling of the TP factor.
         compute_s *= 1.0 + 0.15 * float(np.log2(cand.model_parallel))
+    if cand.pipeline_parallel > 1:
+        # GPipe bubble: of M+n-1 schedule ticks only M do useful work per
+        # stage, so devices idle a (n-1)/(M+n-1) fraction of the step —
+        # the planner prices pipelining as slower at equal memory, picking
+        # it only when flat layouts are pruned (the design intent: PP is
+        # the capacity axis of last resort, like TP's efficiency penalty).
+        m_pipe = max(int(cand.num_microbatches), 1)
+        compute_s *= (m_pipe + cand.pipeline_parallel - 1) / m_pipe
     comm_s = comm_bytes / consts["comm_bw"]
     dispatch_s = consts["dispatch_s"] / cand.steps_per_execution
     return {
@@ -519,32 +578,52 @@ def enumerate_candidates(
     grad_accums: Sequence[int] = (1, 2, 4),
     steps_per_execution: Sequence[int] = (1, 8),
     include_tp: bool = True,
+    include_pp: bool = True,
 ) -> List[Candidate]:
     """The candidate matrix for a device count: strategies x precision x
     grad_accum x steps_per_execution. TP mesh shapes come from the
     divisors of the device count and are proposed only when the module
     carries Megatron sharding hints (an unhinted model would shard
-    nothing)."""
-    strategies: List[Tuple[str, int]] = []
+    nothing); PP stage counts likewise come from the divisors and are
+    proposed only when the hints carry a 'pipe' role (a PipelinedBlocks
+    stack), each at microbatch counts M in {n, 2n} — the bubble/MXU
+    trade's two canonical points."""
+    strategies: List[Tuple[str, int, int, int]] = []  # (name, tp, pp, M)
     if n_devices == 1:
-        strategies.append(("single_device", 1))
+        strategies.append(("single_device", 1, 1, 1))
     else:
-        strategies += [("single_device", 1), ("dp", 1), ("zero1", 1),
-                       ("fsdp", 1)]
+        strategies += [("single_device", 1, 1, 1), ("dp", 1, 1, 1),
+                       ("zero1", 1, 1, 1), ("fsdp", 1, 1, 1)]
         if include_tp and hints:
             for m in range(2, n_devices + 1):
                 if n_devices % m == 0:
-                    strategies.append(("tp", m))
+                    strategies.append(("tp", m, 1, 1))
+        if include_pp and _hints_have_pipe(hints):
+            for pp in range(2, n_devices + 1):
+                if n_devices % pp == 0:
+                    for mb in (pp, 2 * pp):
+                        strategies.append(("pp", 1, pp, mb))
     out = []
-    for name, m in strategies:
+    for name, m, pp, mb in strategies:
         for prec in precisions:
             for ga in grad_accums:
                 for k in steps_per_execution:
                     out.append(Candidate(
-                        strategy=name, model_parallel=m, precision=prec,
+                        strategy=name, model_parallel=m,
+                        pipeline_parallel=pp, num_microbatches=mb,
+                        precision=prec,
                         grad_accum=int(ga), steps_per_execution=int(k),
                     ))
     return out
+
+
+def _hints_have_pipe(hints) -> bool:
+    """True when any node of the hint tree carries the 'pipe' role."""
+    if hints == "pipe":
+        return True
+    if isinstance(hints, dict):
+        return any(_hints_have_pipe(v) for v in hints.values())
+    return False
 
 
 # ------------------------------------------------------------------ planning --
@@ -561,6 +640,7 @@ def plan_sharding(
     grad_accums: Optional[Sequence[int]] = None,
     steps_per_execution: Optional[Sequence[int]] = None,
     include_tp: bool = True,
+    include_pp: bool = True,
     measure: bool = False,
     measure_fn: Optional[
         Callable[[Candidate, dict], Optional[float]]
@@ -620,7 +700,7 @@ def plan_sharding(
     candidates = enumerate_candidates(
         len(devices), hints=abstracts["hints"], precisions=precisions,
         grad_accums=grad_accums, steps_per_execution=steps_per_execution,
-        include_tp=include_tp,
+        include_tp=include_tp, include_pp=include_pp,
     )
     feasible, pruned = [], []
     for cand in candidates:

@@ -14,7 +14,11 @@ pieces:
   ``nn.MultiHeadAttention.paged_decode``): one HBM pool of fixed-size
   blocks shared by all slots, allocated on demand and freed on eviction,
   with the cache dtype derived from the model's precision policy
-  (``Model.decode_dtype()``).
+  (``Model.decode_dtype()``). Stacked-block models (``ScannedBlocks``,
+  and ``PipelinedBlocks`` on its sequential off-mesh path) serve through
+  the same pools, stacked per layer under one reserved ``"stacked"`` key
+  (``nn.scan.STACKED_POOL_KEY``) — a LIVE pipe mesh raises instead
+  (docs/SERVING.md "Stacked blocks").
 - **Prefill/decode split**: a prompt is cached by its own PARALLEL
   dispatch (optionally chunked via ``prefill_chunk``, which bounds how
   much work ever sits between two decode steps) instead of crawling

@@ -255,29 +255,42 @@ class PrefixStore:
         return dropped
 
 
-def _map_pools(fn, tree):
-    """Map ``fn`` over the leaf arrays of a paged-cache tree. Local
-    traversal instead of jax.tree_util so this module's import graph
-    stays numpy-only (the arrays themselves are jnp; ``.at[]`` needs no
-    import)."""
+# nn.scan.STACKED_POOL_KEY, spelled out so this module's import graph
+# stays numpy-only: pools below a dict key with this name carry a leading
+# (S, ...) block-STACK dim (ScannedBlocks / PipelinedBlocks), putting the
+# pool-block axis at 1 instead of 0 — copy-on-write and per-block byte
+# accounting must index/skip accordingly.
+_STACKED_POOL_KEY = "stacked"
+
+
+def _map_pools(fn, tree, stacked=False):
+    """Map ``fn(leaf, stacked)`` over the leaf arrays of a paged-cache
+    tree (``stacked`` = the leaf sits below a ``_STACKED_POOL_KEY``
+    subtree). Local traversal instead of jax.tree_util so this module's
+    import graph stays numpy-only (the arrays themselves are jnp;
+    ``.at[]`` needs no import)."""
     if isinstance(tree, dict):
-        return {k: _map_pools(fn, v) for k, v in tree.items()}
+        return {
+            k: _map_pools(fn, v, stacked or k == _STACKED_POOL_KEY)
+            for k, v in tree.items()
+        }
     if isinstance(tree, (list, tuple)):
-        return type(tree)(_map_pools(fn, v) for v in tree)
-    return fn(tree)
+        return type(tree)(_map_pools(fn, v, stacked) for v in tree)
+    return fn(tree, stacked)
 
 
-def _pool_leaves(tree, out=None):
+def _pool_leaves(tree, out=None, stacked=False):
+    """(leaf, stacked) pairs in sorted-key order."""
     if out is None:
         out = []
     if isinstance(tree, dict):
         for k in sorted(tree):
-            _pool_leaves(tree[k], out)
+            _pool_leaves(tree[k], out, stacked or k == _STACKED_POOL_KEY)
     elif isinstance(tree, (list, tuple)):
         for v in tree:
-            _pool_leaves(v, out)
+            _pool_leaves(v, out, stacked)
     else:
-        out.append(tree)
+        out.append((tree, stacked))
     return out
 
 
@@ -413,7 +426,11 @@ class PagedKVCache:
         new = grant[0]
         old = self._slot_blocks[slot][index]
         self.caches = _map_pools(
-            lambda pool: pool.at[new].set(pool[old]), self.caches
+            lambda pool, stacked: (
+                pool.at[:, new].set(pool[:, old]) if stacked
+                else pool.at[new].set(pool[old])
+            ),
+            self.caches,
         )
         self._slot_blocks[slot][index] = new
         self.block_tables[slot, index] = new
@@ -458,10 +475,15 @@ class PagedKVCache:
         (quantized pools count q + scale) — the int8-KV capacity-ratio
         denominator."""
         total = 0
-        for leaf in _pool_leaves(self.caches):
+        for leaf, stacked in _pool_leaves(self.caches):
             per = leaf.dtype.itemsize
-            for d in leaf.shape[1:]:
+            # Stacked pools: (S, num_blocks, ...) — one logical block is
+            # S per-layer slices, so skip the block axis (1) and multiply
+            # the stack depth back in.
+            for d in leaf.shape[2:] if stacked else leaf.shape[1:]:
                 per *= int(d)
+            if stacked:
+                per *= int(leaf.shape[0])
             total += per
         return int(total)
 

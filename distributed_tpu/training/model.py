@@ -270,7 +270,7 @@ class Model:
         shortlisted candidate — the estimate-only default does not).
         ``auto_options`` passes planner knobs through (``batch_size``,
         ``devices``, ``grad_accums``, ``precisions``, ``include_tp``,
-        ``top_k``). The decision record lands in ``model.last_plan``,
+        ``include_pp``, ``top_k``). The decision record lands in ``model.last_plan``,
         ``model.last_fit_telemetry["plan"]``, and the JSONL event log
         (``auto_shard_plan``); see docs/PERF.md "Autotuned sharding".
 
@@ -1257,6 +1257,9 @@ class Model:
         # than inherit another model's record).
         from ..nn import scan as _nn_scan
         _nn_scan._overlap_trace.record = None
+        # Same reset for the pipeline-schedule trace record (nn/pipeline.py).
+        from ..nn import pipeline as _nn_pipeline
+        _nn_pipeline._pipeline_trace.record = None
         # Observability runtime (docs/OBSERVABILITY.md): per-dispatch
         # flight records + step-seconds ring, and a periodic cross-rank
         # metrics_snapshot flush over the supervisor's event-log
@@ -1679,6 +1682,38 @@ class Model:
                 layers=report["overlap"]["layers"],
                 strategy=type(self.strategy).__name__,
             )
+        # Pipeline-schedule attribution (PipelinedBlocks x schedule): the
+        # trace-time record of the most recent pipelined apply on this
+        # thread — which schedule ran, its static tick count, and the
+        # analytic bubble fraction (n-1)/ticks. Same warm-cache fallback
+        # discipline as the overlap record above (docs/PERF.md "Pipeline
+        # round 2").
+        from ..nn.pipeline import last_pipeline_trace
+        _ptrace = last_pipeline_trace()
+        if _ptrace is None:
+            _ptrace = getattr(self, "_pipeline_record", None)
+        else:
+            self._pipeline_record = _ptrace
+        if _ptrace is not None:
+            report["pipeline"] = dict(_ptrace)
+            if obs_registry.enabled() and events_lib.default_log() is not None:
+                events_lib.emit(
+                    evs.PIPELINE_SCHEDULE_SELECTED,
+                    schedule=_ptrace["schedule"],
+                    interleave=_ptrace["interleave"],
+                    num_stages=_ptrace["num_stages"],
+                    num_microbatches=_ptrace["num_microbatches"],
+                    strategy=type(self.strategy).__name__,
+                )
+                events_lib.emit(
+                    evs.BUBBLE_REPORT,
+                    bubble_fraction=_ptrace["bubble_fraction"],
+                    ticks=_ptrace["ticks"],
+                    schedule=_ptrace["schedule"],
+                    interleave=_ptrace["interleave"],
+                    num_stages=_ptrace["num_stages"],
+                    num_microbatches=_ptrace["num_microbatches"],
+                )
         # The auto-shard decision record rides with every fit it governed:
         # chosen config, predicted bytes/traffic, and the pruned
         # candidates' rationale (docs/PERF.md "Autotuned sharding").

@@ -83,6 +83,8 @@ TRANSPORT_FALLBACK = "transport_fallback"
 # startup).
 OVERLAP_REPORT = "overlap_report"
 DECODE_KERNEL_SELECTED = "decode_kernel_selected"
+PIPELINE_SCHEDULE_SELECTED = "pipeline_schedule_selected"
+BUBBLE_REPORT = "bubble_report"
 
 
 # -------------------------------------------------------------- schema
@@ -259,6 +261,20 @@ EVENTS: Dict[str, dict] = {
         "required": ("kernel",),
         "optional": ("backend", "interpret"),
     },
+    # Per-FIT: which pipeline microbatch schedule the PipelinedBlocks
+    # stack traced (gpipe | interleaved) and its static shape.
+    PIPELINE_SCHEDULE_SELECTED: {
+        "required": ("schedule", "interleave"),
+        "optional": ("num_stages", "num_microbatches", "strategy"),
+    },
+    # Per-FIT: the schedule's analytic idle fraction — (n-1)/ticks, where
+    # ticks = interleave*M + n - 1. The lever a too-high bubble names is
+    # more microbatches or a deeper interleave, not a bigger cluster.
+    BUBBLE_REPORT: {
+        "required": ("bubble_fraction", "ticks"),
+        "optional": ("schedule", "interleave", "num_stages",
+                     "num_microbatches"),
+    },
 }
 
 
@@ -288,4 +304,5 @@ __all__ = [
     "PREFIX_CACHE_HIT", "PREFIX_EVICT", "SPEC_VERIFY",
     "SERVICE_START", "REPLICA_SPAWN", "STREAM_OPEN", "QUOTA_REJECT",
     "TRANSPORT_FALLBACK", "OVERLAP_REPORT", "DECODE_KERNEL_SELECTED",
+    "PIPELINE_SCHEDULE_SELECTED", "BUBBLE_REPORT",
 ]

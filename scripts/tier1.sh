@@ -211,6 +211,23 @@ if [ -n "${TIER1_KERNEL_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_PIPELINE_SMOKE=1: same idea for the pipeline third axis — runs
+# the PipelinedBlocks schedule/parity tests and the planner's DP x TP x
+# PP rows in-tier (~45 s), then the bench pipeline smoke WITHOUT the
+# slow filter (it is @slow: ~8 shard_map compiles) so schedule/planner/
+# stacked-serving changes iterate fast. The measured artifact comes from
+# `python bench.py pipeline` (BENCH_pipeline.json). NOT a tier-1
+# substitute.
+if [ -n "${TIER1_PIPELINE_SMOKE:-}" ]; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_pipeline_parallel.py \
+        tests/test_autoshard.py -q -m 'not slow' \
+        --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly \
+        || exit 1
+    exec env JAX_PLATFORMS=cpu python -m pytest \
+        "tests/test_bench.py::test_bench_pipeline_smoke" \
+        -q --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 BUDGET="${TIER1_BUDGET_SECONDS:-850}"
 rm -f "$LOG"

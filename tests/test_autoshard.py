@@ -51,6 +51,7 @@ class TestCommSchema:
         "gathered_param_bytes_per_device",
         "grad_reduce_bytes_per_device",
         "activation_reduce_bytes_per_token_per_device",
+        "pipeline_hop_bytes_per_token_per_device",
     }
 
     def _strategies(self):
@@ -60,6 +61,7 @@ class TestCommSchema:
             "zero1": dtpu.ZeroDataParallel(),
             "fsdp": dtpu.FSDP(),
             "tp": dtpu.DataTensorParallel(model_parallel=2),
+            "pp": dtpu.DataPipelineParallel(pipeline_parallel=2),
         }
 
     def test_unified_keys_across_all_strategies(self):
@@ -239,7 +241,8 @@ class TestFeasibility:
                                 batch_size=3, grad_accums=(1,),
                                 steps_per_execution=(1,))
         assert plan_tp.chosen["config"] == {
-            "strategy": "tp", "model_parallel": 8, "precision": None,
+            "strategy": "tp", "model_parallel": 8, "pipeline_parallel": 1,
+            "num_microbatches": 1, "precision": None,
             "grad_accum": 1, "steps_per_execution": 1,
         }
 
@@ -276,6 +279,108 @@ class TestRanking:
             assert row["est_step_seconds"] > 0
             assert set(row["cost_breakdown"]) == {"compute_s", "comm_s",
                                                   "dispatch_s"}
+
+
+# ------------------------------------------------------ pipeline third axis --
+class TestPipelinePlanner:
+    """DP x TP x PP: the planner's third axis. All estimate-only (no
+    dispatch, no mesh commit) per the in-tier planner budget."""
+
+    # Every dim indivisible by any 8-divisor: _largest_divisible_spec
+    # degrades DP/ZeRO/FSDP to full replication and the pipelined stack's
+    # 'pipe' hints leave TP nothing to shard — depth is the ONLY axis
+    # that still splits state. Same shape as bench.py's pipeline row 1.
+    AWKWARD = dict(vocab=331, num_layers=4, d_model=36, num_heads=2,
+                   d_ff=84, max_len=33, pipeline=True)
+
+    def _plan(self, mod, **kw):
+        kw.setdefault("optimizer", "adam")
+        kw.setdefault("batch_size", 16)
+        kw.setdefault("grad_accums", (1,))
+        kw.setdefault("steps_per_execution", (1,))
+        return plan_sharding(mod, (SEQ,), **kw)
+
+    def test_pipeline_hop_priced_exactly(self):
+        """Satellite 1: DataPipelineParallel's comm_bytes_estimate prices
+        the boundary activation ppermute instead of inheriting DP's
+        zero-pipeline-traffic row: min stacked block width x itemsize x
+        ceil-ish hop count (M+n-2)//M per token."""
+        mod = _lm(num_layers=4, pipeline=True)
+        params, _, _ = mod.init(jax.random.PRNGKey(0), (SEQ,))
+        hints = mod.sharding_hints()
+        pp = dtpu.DataPipelineParallel(pipeline_parallel=2,
+                                       num_microbatches=4)
+        est = pp.comm_bytes_estimate(params, hints=hints)
+        assert set(est) == TestCommSchema.KEYS
+        # d_model=32 f32 over pp2/M4: 32 * 4 * (4 + 2 - 2) // 4.
+        assert est["pipeline_hop_bytes_per_token_per_device"] == 128
+        # Stage-sharded grads reduce 1/n-sized pieces over the data axis.
+        dp = dtpu.DataParallel().comm_bytes_estimate(params, hints=hints)
+        assert dp["pipeline_hop_bytes_per_token_per_device"] == 0
+        assert 0 < est["grad_reduce_bytes_per_device"] \
+            < dp["grad_reduce_bytes_per_device"]
+
+    def test_pp_rows_gated_on_pipe_hints(self):
+        from distributed_tpu.parallel.auto_shard import (
+            _hints_have_pipe, _pipe_stage_count,
+        )
+
+        flat = _lm()
+        assert not _hints_have_pipe(flat.sharding_hints())
+        labels = [r["label"] for r in self._plan(flat).candidates]
+        assert not any(l.startswith("pp") for l in labels)
+
+        piped = _lm(num_layers=4, pipeline=True)
+        hints = piped.sharding_hints()
+        assert _hints_have_pipe(hints)
+        params, _, _ = piped.init(jax.random.PRNGKey(0), (SEQ,))
+        assert _pipe_stage_count(params, hints) == 4
+        plan = self._plan(piped)
+        rows = ([r["label"] for r in plan.candidates]
+                + [r["label"] for r in plan.pruned])
+        assert any(l.startswith("pp2") for l in rows), rows
+        assert any(l.startswith("pp4") for l in rows), rows
+        # The explicit opt-out drops the axis entirely.
+        off = self._plan(piped, include_pp=False)
+        rows_off = ([r["label"] for r in off.candidates]
+                    + [r["label"] for r in off.pruned])
+        assert not any(l.startswith("pp") for l in rows_off)
+
+    def test_capped_awkward_dims_pick_pp2(self):
+        """The acceptance scenario: under a cap that only a 2-stage
+        pipeline fits, the planner picks pp2 and prunes every flat
+        layout WITH the hbm_cap rationale."""
+        mod = _lm(**self.AWKWARD)
+        pre = self._plan(mod)
+        need = {}
+        for r in pre.candidates + [p for p in pre.pruned
+                                   if "state_bytes_per_device" in p]:
+            need[r["label"]] = (r["state_bytes_per_device"]
+                               + r["activation_bytes_per_device"])
+        pp2 = min(v for k, v in need.items() if k.startswith("pp2"))
+        rest = min(v for k, v in need.items() if not k.startswith("pp2"))
+        assert pp2 < rest, need  # depth is the only axis that helps
+        cap = (pp2 + rest) // 2
+        plan = self._plan(mod, hbm_cap_bytes=cap)
+        cfg = plan.chosen["config"]
+        assert cfg["strategy"] == "pp" and cfg["pipeline_parallel"] == 2
+        pruned = {r["config"]["strategy"] for r in plan.pruned
+                  if "config" in r and "hbm_cap" in r["reason"]}
+        assert {"dp", "zero1", "fsdp"} <= pruned
+        # Deterministic: same inputs, byte-identical summary.
+        import json
+        again = self._plan(mod, hbm_cap_bytes=cap)
+        assert json.dumps(plan.summary(), sort_keys=True) == \
+            json.dumps(again.summary(), sort_keys=True)
+
+    def test_pp_divisibility_pruned_with_rationale(self):
+        # 6 stages over pp4 can't place evenly; the row must be pruned
+        # with the stage rationale, not crash or silently vanish.
+        mod = _lm(num_layers=6, pipeline=True)
+        plan = self._plan(mod)
+        pruned = {r["label"]: r["reason"] for r in plan.pruned}
+        pp4 = [v for k, v in pruned.items() if k.startswith("pp4")]
+        assert pp4 and all("stages" in r for r in pp4), pruned
 
 
 # ----------------------------------------------------------- compile("auto") --

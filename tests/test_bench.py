@@ -586,3 +586,30 @@ def test_bench_decode_kernel_smoke():
     preempt_row = next(r for r in out["configs"]
                        if r["config"] == "preemption")
     assert preempt_row["preemptions"] > 0
+
+
+# @slow (tier-1 budget, PR 19): ~8 pipeline shard_map compiles + 6 serving
+# engines even at smoke shapes; the in-tier coverage of every asserted
+# mechanism lives in test_pipeline_parallel.py (schedule parity/telemetry),
+# test_autoshard.py (capped pp2 pick) and test_serving.py (stacked paged
+# parity). Runs in TIER1_PIPELINE_SMOKE (no -m filter on the bench leg);
+# the real artifact comes from `python bench.py pipeline`.
+@pytest.mark.slow
+def test_bench_pipeline_smoke():
+    out = bench.bench_pipeline(warmup=1, measure=2, windows=1,
+                               num_requests=3, max_slots=2)
+    assert out["unit"] == "idle fraction"
+    assert out["value"] < out["rows"][1]["gpipe_bubble_fraction"]
+    capped, sched, paged = out["rows"]
+    assert capped["value"].startswith("pp2")
+    assert capped["flat_layouts_pruned"] is True
+    assert capped["plan"]["chosen"]["config"]["strategy"] == "pp"
+    assert capped["trained_loss"] > 0
+    assert sched["schedule_shape"]["gpipe_ticks"] == 5
+    assert sched["schedule_shape"]["interleaved_ticks"] == 9
+    assert sched["loss_parity_rtol"] == 2e-5
+    assert sched["speedup_asserted"] is False
+    assert paged["value"] is True
+    assert [r["config"] for r in paged["configs"]] == [
+        "reference", "fused", "fused_prefix"]
+    assert all(r["token_exact_vs_dense"] for r in paged["configs"])

@@ -347,3 +347,120 @@ def test_update_weights_validates_loudly(lm):
     with pytest.raises(ValueError, match="dtype mismatch"):
         engine.update_weights(wrong_dtype)
     assert engine.weights_version == 0  # failed swaps change nothing
+
+
+# -------------------------------------------------- stacked-block serving --
+@pytest.fixture(scope="module")
+def scanned_lm():
+    """ScannedBlocks LM: one weight-stacked block, paged pools carried
+    under the reserved 'stacked' key with a leading (S, ...) stage dim."""
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=3, d_model=16, num_heads=2, max_len=64, scan=True))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    return model
+
+
+def test_scanned_stack_paged_parity_and_batch_churn(scanned_lm):
+    """The tentpole's serving leg: a ScannedBlocks LM served through the
+    paged engine is token-exact against its own dense generate(), and a
+    second run with a different batch composition reuses the exact same
+    compiled prefill/decode programs (the stacked pool rides the fixed
+    dispatch shapes)."""
+    prompts, news = _requests(seed=11, n=4)
+    want = _sequential_generate(scanned_lm, prompts, news)
+    engine = Engine(scanned_lm, max_slots=2, block_size=4, max_len=64)
+    got = engine.run([Request(p, m) for p, m in zip(prompts, news)])
+    for i, (w, g) in enumerate(zip(want, got)):
+        np.testing.assert_array_equal(w, g, err_msg=f"request {i}")
+    assert engine.kv.live_blocks == 0
+    # Batch churn: different request count/lengths, zero new compiles.
+    prompts2, news2 = _requests(seed=12, n=3, p_range=(2, 7),
+                                m_range=(4, 8))
+    want2 = _sequential_generate(scanned_lm, prompts2, news2)
+    with assert_no_recompile(engine._decode_jit, engine._prefill_jit):
+        got2 = engine.run([Request(p, m)
+                           for p, m in zip(prompts2, news2)])
+    for w, g in zip(want2, got2):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_scanned_stack_composes_fused_and_prefix(scanned_lm):
+    """PR 18's fused decode kernel and PR 16's prefix cache both reach
+    the stacked pool through the same hooks: parity must hold with the
+    fused kernel selected, and again with the prefix store sharing a
+    common prompt head across requests."""
+    rng = np.random.default_rng(5)
+    common = rng.integers(0, 31, (16,)).astype(np.int32)
+    prompts = [np.concatenate([common, np.array([t], np.int32)])
+               for t in (3, 9, 17, 26)]
+    news = [6, 7, 5, 6]
+    want = _sequential_generate(scanned_lm, prompts, news)
+    fused = Engine(scanned_lm, max_slots=2, block_size=4, max_len=64,
+                   decode_kernel="fused")
+    got = fused.run([Request(p, m) for p, m in zip(prompts, news)])
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    both = Engine(scanned_lm, max_slots=2, block_size=4, max_len=64,
+                  decode_kernel="fused", prefix_cache=True)
+    got2 = both.run([Request(p, m) for p, m in zip(prompts, news)])
+    for w, g in zip(want, got2):
+        np.testing.assert_array_equal(w, g)
+    # The waves behind the first two slots re-read the shared 16-token
+    # head (4 full blocks) from the store instead of recomputing it.
+    rep = both.last_run_telemetry["prefix_cache"]
+    assert rep["hit_blocks"] > 0 and rep["hit_tokens"] > 0
+
+
+def test_pipelined_blocks_serve_paged_off_pipe_mesh():
+    """PipelinedBlocks serves through the same stacked hooks on its
+    sequential single-device path — training topology (pipe mesh) and
+    serving topology are independent choices."""
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=2, d_model=16, num_heads=2, max_len=64,
+        pipeline=True))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    prompts, news = _requests(seed=13, n=2)
+    want = _sequential_generate(model, prompts, news)
+    engine = Engine(model, max_slots=2, block_size=4, max_len=64)
+    got = engine.run([Request(p, m) for p, m in zip(prompts, news)])
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_pipelined_paged_on_live_pipe_mesh_raises(devices):
+    """On a live pipe mesh the paged pool would split across ranks while
+    the allocator/prefix state assumes one address space — a loud raise,
+    not a silent gather."""
+    from distributed_tpu import nn
+
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=2, d_model=16, num_heads=2, max_len=64,
+        pipeline=True))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    pb = next(l for l in model.module.layers
+              if isinstance(l, nn.PipelinedBlocks))
+
+    def subtree(p):  # the layer's own params ({"blocks": ...})
+        if isinstance(p, dict):
+            if "blocks" in p:
+                return p
+            for v in p.values():
+                found = subtree(v)
+                if found is not None:
+                    return found
+        return None
+
+    strategy = dtpu.DataPipelineParallel(pipeline_parallel=2)
+    with strategy.scope():
+        with pytest.raises(NotImplementedError, match="single-device"):
+            pb.init_paged_cache(subtree(model.params), 8, 4, jnp.float32)
+        with pytest.raises(NotImplementedError, match="single-device"):
+            pb.paged_decode(subtree(model.params), {}, {},
+                            jnp.zeros((1, 1, 16)),
+                            block_tables=jnp.zeros((1, 8), jnp.int32),
+                            positions=jnp.zeros((1,), jnp.int32))
