@@ -37,7 +37,11 @@ including the virtual-clock harness used on single-host boxes.
 
 from .autoscale import QueueAutoscaler
 from .core import FleetResult, ServingFleet
-from .handoff import HandoffIncompatible, KVHandoff, install_kv, pack_kv
+from .gossip import PrefixGossipIndex
+from .handoff import (
+    HandoffIncompatible, KVHandoff, adopt_prefix, install_kv, pack_kv,
+    pack_prefix,
+)
 from .replica import DecodeReplica, EnginePrograms, PrefillReplica
 from .router import Admission, Router
 
@@ -52,6 +56,9 @@ __all__ = [
     "DecodeReplica",
     "KVHandoff",
     "HandoffIncompatible",
+    "PrefixGossipIndex",
     "pack_kv",
     "install_kv",
+    "pack_prefix",
+    "adopt_prefix",
 ]

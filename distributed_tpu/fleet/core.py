@@ -46,8 +46,12 @@ import numpy as np
 from ..serving.scheduler import Request, Sequence
 from ..utils import event_schema as evs
 from ..utils import events as events_lib
+from ..serving.kv_cache import _chain_hashes
 from .autoscale import QueueAutoscaler
-from .handoff import trim_kv
+from .gossip import PrefixGossipIndex
+from .handoff import (
+    HandoffIncompatible, adopt_prefix, pack_prefix, trim_kv,
+)
 from .replica import DecodeReplica, EnginePrograms, PrefillReplica
 from .router import Router
 
@@ -76,6 +80,20 @@ class ServingFleet:
     handoff payloads are TRIMMED to the non-cached suffix before
     shipping (``fleet.handoff.trim_kv``) — telemetry reports the bytes
     saved.
+
+    ``prefix_gossip=True`` (requires ``prefix_cache``) federates those
+    per-replica stores through a :class:`~distributed_tpu.fleet.gossip.
+    PrefixGossipIndex`: replicas advertise their chain-hash keys after
+    every step, placement consults the global view (a request whose
+    prefix SOME peer holds treats every prefix-caching replica as warm),
+    and the fleet moves the blocks at placement time —
+    ``pack_prefix`` on the warm side, ``adopt_prefix`` on the cold one,
+    the copy charged to both replicas' timelines. A cold replica then
+    admits with ``cached_len > 0`` and never re-prefills a shared
+    prefix (``handoffs.prefills_full`` telemetry proves it). Every
+    advertisement and payload carries ``weights_version``;
+    :meth:`update_weights` bumps it, flushes every store, and withdraws
+    every advertisement, so stale-weights blocks can never travel.
     """
 
     def __init__(self, model, *, decode_replicas: int = 2,
@@ -85,6 +103,7 @@ class ServingFleet:
                  prefill_chunk: Optional[int] = None,
                  transfer: str = "blocks",
                  prefix_cache: bool = False,
+                 prefix_gossip: bool = False,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None, seed: int = 0,
                  router: Optional[Router] = None,
@@ -102,6 +121,11 @@ class ServingFleet:
         if transfer not in ("blocks", "none"):
             raise ValueError(
                 f"transfer must be 'blocks' or 'none', got {transfer!r}"
+            )
+        if prefix_gossip and not prefix_cache:
+            raise ValueError(
+                "prefix_gossip=True requires prefix_cache=True — the "
+                "gossip index advertises the per-replica prefix stores"
             )
         self.model = model
         self.programs = programs or EnginePrograms(
@@ -122,6 +146,9 @@ class ServingFleet:
         self.prefill_chunk = prefill_chunk
         self.transfer = transfer
         self.prefix_cache = bool(prefix_cache)
+        self.prefix_gossip = bool(prefix_gossip)
+        self.gossip = PrefixGossipIndex() if prefix_gossip else None
+        self.weights_version = 0
         self.eos_id = eos_id
         self.router = router or Router()
         self.autoscaler = autoscaler
@@ -178,10 +205,15 @@ class ServingFleet:
         return {
             "decode_steps": rep.decode_steps,
             "prefill_dispatches": rep.prefill_dispatches,
+            "prefills_full": rep.prefills_full,
             "preemptions": rep.preemptions,
             "handoffs_installed": rep.handoffs_installed,
             "handoffs_fallback": rep.handoffs_fallback,
             "handoffs_trim_stale": rep.handoffs_trim_stale,
+            "gossip_adopts": rep.gossip_adopts,
+            "gossip_adopt_blocks": rep.gossip_adopt_blocks,
+            "gossip_serves": rep.gossip_serves,
+            "gossip_advertised": rep.gossip_advertised,
             "busy_s": round(rep.busy_s, 4),
             "alive": rep.alive,
         }
@@ -190,6 +222,10 @@ class ServingFleet:
         rep = self.decode_pool.pop(name)
         self._retired_rows[name] = self._replica_row(rep)
         self._warming.pop(name, None)
+        if self.gossip is not None:
+            # A retired/killed replica's pool dies with it: its
+            # advertisements must not linger as adoptable claims.
+            self.gossip.withdraw(name)
         self.pool_events.append({
             "t": round(now, 4), "event": "retire", "replica": name,
         })
@@ -217,6 +253,42 @@ class ServingFleet:
                     changed = True
                     break
         return changed
+
+    # -------------------------------------------------------- weight swap
+    def update_weights(self, params) -> int:
+        """Hot-swap the fleet's served weights (the Engine
+        ``update_weights`` contract, pool-wide): validate the new tree
+        against the live one, re-place it under the model's strategy,
+        and swap — replicas dispatch through ``programs.model.params``,
+        so the swap is atomic at dispatch granularity for every replica
+        at once.
+
+        Staleness discipline: every replica's prefix store is flushed
+        (cached KV was computed under the old weights) AND its gossip
+        advertisement withdrawn, and ``weights_version`` bumps — so even
+        an advertisement that somehow survived (or a payload packed
+        before the swap, in a real multi-process deployment) fails the
+        stamp check at adoption time instead of seeding a new request
+        from one-update-old KV. Returns the new version."""
+        from ..serving.engine import _validate_swap
+
+        model = self.programs.model
+        _validate_swap(model.params, params, "fleet.update_weights")
+        placed = model.strategy.put_params(
+            params, hints=model.module.sharding_hints()
+        )
+        jax.block_until_ready(placed)
+        model.params = placed
+        self.weights_version += 1
+        for name, rep in sorted(self.decode_pool.items()):
+            if rep.kv.prefix is not None:
+                rep.kv.prefix.flush(rep.kv.allocator)
+            if self.gossip is not None:
+                self.gossip.withdraw(name)
+        for rep in self.prefill_pool:
+            if rep.kv.prefix is not None:
+                rep.kv.prefix.flush(rep.kv.allocator)
+        return self.weights_version
 
     # ---------------------------------------------------------------- run
     def run(self, requests: SequenceT, *,
@@ -262,6 +334,8 @@ class ServingFleet:
         pending_handoff: List[list] = []  # [ready_at, seq, payload]
         kills: List[dict] = []
         fallback_dispatches = 0  # re-prefills: transfer off / replica lost
+        gossip_adoptions: List[dict] = []  # placement-time block moves
+        gossip_stale = 0  # adoptions refused by the weights-version stamp
         handoff_bytes_full = 0     # payload bytes before suffix trimming
         handoff_bytes_shipped = 0  # payload bytes actually transferred
         suffix_trims = 0           # payloads that shipped suffix-only
@@ -377,15 +451,78 @@ class ServingFleet:
                 progressed = True
             for item in dispatchable:
                 _, seq, payload = item
+                # Gossip lookup BEFORE placement: if some peer advertises
+                # this sequence's prefix at the current weights version,
+                # every prefix-caching replica is adoptable-warm and the
+                # router may spread the load instead of pinning it.
+                peer, peer_keys = None, ()
+                if self.gossip is not None and payload is None:
+                    keys = _chain_hashes(
+                        seq.tokens[:seq.prompt_len], self.block_size
+                    )
+                    if keys:
+                        name, run = self.gossip.best_peer(
+                            keys, weights_version=self.weights_version
+                        )
+                        if name is not None and run > 0:
+                            peer, peer_keys = name, tuple(keys[:run])
                 target = self.router.place(
                     seq,
                     (r for r in self.decode_pool.values()
                      if self._ready(r, now) and r.free_slots > 0),
+                    gossip_adoptable=peer is not None,
                 )
                 if target is None:
                     # No capacity: hold as pending, re-offered next pass.
                     pending_handoff.append([now, seq, payload])
                     continue
+                if (peer is not None and peer != target.name
+                        and not target.holds_prefix(seq)):
+                    src = self.decode_pool.get(peer)
+                    if src is not None and src.alive:
+                        t0 = time.perf_counter()
+                        adopted = 0
+                        try:
+                            pay = pack_prefix(
+                                src.kv, peer_keys,
+                                weights_version=self.weights_version,
+                            )
+                            if pay is not None:
+                                adopted = adopt_prefix(
+                                    target.kv, pay,
+                                    weights_version=self.weights_version,
+                                )
+                        except HandoffIncompatible:
+                            gossip_stale += 1
+                        # The gather/scatter is real device work on both
+                        # ends: charge each replica's own timeline, like
+                        # any other dispatch.
+                        dt = time.perf_counter() - t0
+                        src.busy_s += dt
+                        src.busy_until = max(src.busy_until, now + dt)
+                        target.busy_s += dt
+                        target.busy_until = max(
+                            target.busy_until, now + dt
+                        )
+                        if adopted:
+                            src.gossip_serves += 1
+                            target.gossip_adopts += 1
+                            target.gossip_adopt_blocks += adopted
+                            gossip_adoptions.append({
+                                "t": round(now, 4),
+                                "request_id": seq.request.request_id,
+                                "from": src.name, "to": target.name,
+                                "blocks": int(adopted),
+                                "copy_s": round(dt, 6),
+                            })
+                            events_lib.emit(
+                                evs.PREFIX_GOSSIP_ADOPT,
+                                replica=target.name, source=src.name,
+                                blocks=int(adopted),
+                                tokens=int(adopted * self.block_size),
+                                weights_version=self.weights_version,
+                                transport="inproc",
+                            )
                 if payload is None and seq.num_generated > 0:
                     # Prefilled (or partially decoded) elsewhere but the
                     # KV could not travel: the decode side re-prefills.
@@ -412,6 +549,14 @@ class ServingFleet:
                 rep.busy_until = now + dt
                 for seq in finished:
                     record_finish(seq)
+                if self.gossip is not None and rep.kv.prefix is not None:
+                    # Advertise-sync after the step wrote new prefix
+                    # blocks: REPLACE semantics, so local eviction
+                    # propagates too (no dangling claims).
+                    rep.gossip_advertised += self.gossip.advertise(
+                        name, rep.kv.prefix.keys(),
+                        weights_version=self.weights_version,
+                    )
                 progressed = True
             queue_peak = max(
                 queue_peak,
@@ -461,6 +606,7 @@ class ServingFleet:
             fallback_dispatches, wall_s=time.perf_counter() - wall0,
             handoff_bytes=(handoff_bytes_full, handoff_bytes_shipped,
                            suffix_trims),
+            gossip_rows=(gossip_adoptions, gossip_stale),
         )
         out = FleetResult(
             results.get(r.request_id) for r in reqs
@@ -471,7 +617,8 @@ class ServingFleet:
     # ----------------------------------------------------------- telemetry
     def _finalize_telemetry(self, reqs, seqs_in_order, admitted, results,
                             kills, queue_peak, fallback_dispatches,
-                            wall_s, handoff_bytes=(0, 0, 0)):
+                            wall_s, handoff_bytes=(0, 0, 0),
+                            gossip_rows=((), 0)):
         fins = [s for s in admitted.values()
                 if s.request.request_id in results]
         ttfts = [s.first_token_at - s.submitted_at for s in fins]
@@ -550,6 +697,9 @@ class ServingFleet:
                 "trim_stale": sum(
                     r["handoffs_trim_stale"] for r in rows.values()
                 ),
+                "prefills_full": sum(
+                    r["prefills_full"] for r in rows.values()
+                ),
                 "bytes_full": int(handoff_bytes[0]),
                 "bytes_shipped": int(handoff_bytes[1]),
                 "bytes_saved": int(handoff_bytes[0] - handoff_bytes[1]),
@@ -563,6 +713,27 @@ class ServingFleet:
                 "target": self.autoscaler.target,
                 "events": list(self.autoscaler.events),
             }
+        if self.gossip is not None:
+            adoptions, stale = gossip_rows
+            tel["gossip"] = {
+                **self.gossip.telemetry(),
+                "weights_version": self.weights_version,
+                "adoptions": len(adoptions),
+                "adopted_blocks": sum(a["blocks"] for a in adoptions),
+                "stale_rejected": int(stale),
+                "events": list(adoptions),
+            }
+            # One advertise event per replica, run-aggregate granularity:
+            # per-step emission would swamp the log with near-identical
+            # advertisements (event-volume discipline, docs/
+            # OBSERVABILITY.md).
+            for name, row in sorted(rows.items()):
+                if row.get("gossip_advertised"):
+                    events_lib.emit(
+                        evs.PREFIX_GOSSIP_ADVERTISE, replica=name,
+                        blocks=int(row["gossip_advertised"]),
+                        weights_version=self.weights_version,
+                    )
         # Publish into the unified metrics registry: the fleet's run view
         # is a stored report (same derived-view contract as fit/engine),
         # with the SLO-facing aggregates doubled as counters/gauges for
@@ -578,4 +749,9 @@ class ServingFleet:
         reg.gauge("fleet/decode_replicas", len(self.decode_pool))
         reg.gauge("fleet/handoff_bytes_saved",
                   tel["handoffs"]["bytes_saved"])
+        if self.gossip is not None:
+            reg.counter("fleet/gossip_adoptions",
+                        tel["gossip"]["adoptions"])
+            reg.counter("fleet/gossip_adopted_blocks",
+                        tel["gossip"]["adopted_blocks"])
         self.last_run_telemetry = reg.set_report("fleet.run", tel)

@@ -35,7 +35,7 @@ re-prefill — never a silent hole in the cache.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +47,7 @@ from ..serving.kv_cache import _chain_hashes
 
 __all__ = [
     "KVHandoff", "HandoffIncompatible", "pack_kv", "install_kv", "trim_kv",
+    "pack_prefix", "adopt_prefix",
 ]
 
 
@@ -99,7 +100,13 @@ class KVHandoff:
     ``prefix_hashes`` are the prompt's chain hashes (one per FULL block,
     ``serving.kv_cache._chain_hashes``) and ``skip_blocks`` how many
     leading blocks :func:`trim_kv` dropped because the receiver's prefix
-    store already held them (0 = full payload)."""
+    store already held them (0 = full payload).
+
+    ``weights_version`` stamps which weights the KV was computed under
+    (gossip payloads; None = unstamped, the prefill→decode path where
+    both sides share one fleet clock). :func:`adopt_prefix` refuses a
+    stamp mismatch — stale blocks must never outlive ``update_weights``
+    by travelling."""
 
     blocks: Dict[str, np.ndarray]
     cached_len: int
@@ -107,6 +114,7 @@ class KVHandoff:
     dtype: str
     prefix_hashes: tuple = ()
     skip_blocks: int = 0
+    weights_version: Optional[int] = None
 
     @property
     def nbytes(self) -> int:
@@ -206,6 +214,137 @@ def install_kv(kv, slot: int, payload: KVHandoff):
         new_leaves.append(pool)
     kv.caches = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return installed
+
+
+def pack_prefix(kv, keys, *,
+                weights_version: Optional[int] = None) -> Optional[KVHandoff]:
+    """Gather the prefix-store blocks for the leading run of chain
+    ``keys`` this pool holds, into a :class:`KVHandoff`-shaped payload —
+    the gossip export side. Unlike :func:`pack_kv` the blocks belong to
+    the STORE, not a slot: a finished request's warm prompt blocks travel
+    to a cold peer without any live sequence being involved. Returns None
+    when the store holds none of ``keys`` (nothing to ship).
+
+    The probe uses ``PrefixStore.peek_run`` — exporting is not an
+    admission, so hit/miss telemetry and LRU order stay untouched."""
+    store = getattr(kv, "prefix", None)
+    if store is None or not keys:
+        return None
+    ids_list = store.peek_run(list(keys))
+    if not ids_list:
+        return None
+    ids = np.asarray(ids_list, np.int32)
+    paths, leaves, _ = _cache_leaves(kv.caches)
+    blocks = {}
+    dtype = None
+    for path, pool in zip(paths, leaves):
+        ax = _block_axis(path)
+        data = np.asarray(jax.device_get(
+            pool[ids] if ax == 0 else pool[:, ids]
+        ))
+        dtype = str(pool.dtype)
+        blocks[_block_key(path, (0,) * data.ndim, data.shape)] = data
+    return KVHandoff(
+        blocks=blocks, cached_len=len(ids_list) * kv.block_size,
+        block_size=int(kv.block_size), dtype=dtype or "",
+        prefix_hashes=tuple(keys[:len(ids_list)]),
+        weights_version=weights_version,
+    )
+
+
+def adopt_prefix(kv, payload: KVHandoff, *,
+                 weights_version: Optional[int] = None) -> int:
+    """Install a gossiped prefix run into THIS pool's prefix store — the
+    import side of :func:`pack_prefix`. Fresh blocks are allocated
+    (store-owned: one reference each, exactly a local ``insert_prefix``'s
+    accounting), the payload's rows scattered in, and each chain key
+    registered; subsequent admissions adopt them through the normal
+    ``PrefixStore.lookup`` path, so everything downstream — refcounts,
+    CoW, eviction — is indistinguishable from a locally-earned prefix.
+
+    Keys already cached are skipped (first writer wins); the walk stops
+    at the first allocation failure, leaving a shorter-but-valid leading
+    run (chain keys make any prefix of a run self-consistent). Raises
+    :class:`HandoffIncompatible` on pool disagreement — the caller then
+    just re-prefills as if no peer had answered. Returns the number of
+    blocks newly adopted."""
+    store = getattr(kv, "prefix", None)
+    if store is None:
+        raise HandoffIncompatible(
+            "adopt_prefix on a pool without a prefix store"
+        )
+    if payload.block_size != kv.block_size:
+        raise HandoffIncompatible(
+            f"block_size mismatch: payload {payload.block_size} vs pool "
+            f"{kv.block_size}"
+        )
+    # The staleness stamp: a payload computed under different weights
+    # must never enter the store — an advertisement that raced an
+    # update_weights flush dies HERE, not as silently-wrong KV.
+    if (weights_version is not None
+            and payload.weights_version is not None
+            and int(payload.weights_version) != int(weights_version)):
+        raise HandoffIncompatible(
+            f"stale gossip payload: weights_version "
+            f"{payload.weights_version} vs current {weights_version}"
+        )
+    keys = list(payload.prefix_hashes)
+    if not keys or not payload.blocks:
+        return 0
+    paths, leaves, treedef = _cache_leaves(kv.caches)
+    by_path: Dict[str, np.ndarray] = {}
+    for bkey, data in payload.blocks.items():
+        path, _starts, _shape = _parse_key(bkey)
+        by_path[path] = data
+    if set(by_path) != set(paths):
+        raise HandoffIncompatible(
+            "layer structure mismatch between gossip peer and local pool "
+            f"(payload layers {sorted(by_path)[:3]}... vs pool "
+            f"{sorted(paths)[:3]}...)"
+        )
+    for path, pool in zip(paths, leaves):
+        data = by_path[path]
+        if str(pool.dtype) != str(data.dtype):
+            raise HandoffIncompatible(
+                f"dtype mismatch on {path}: payload {data.dtype} vs "
+                f"pool {pool.dtype}"
+            )
+    # Chain property: only a LEADING run whose predecessors are all
+    # cached (locally or by this adoption) is admissible. Walk in chain
+    # order, allocating only for the missing keys.
+    src_index: list = []
+    dst_blocks: list = []
+    adopt_keys: list = []
+    for i, key in enumerate(keys):
+        if key in store:
+            continue  # first writer wins; the chain stays contiguous
+        grant = kv._allocate(1)
+        if grant is None:
+            break  # pool dry: keep the shorter leading run
+        src_index.append(i)
+        dst_blocks.append(grant[0])
+        adopt_keys.append(key)
+    if not adopt_keys:
+        return 0
+    src = np.asarray(src_index, np.int32)
+    dst = jnp.asarray(np.asarray(dst_blocks, np.int32))
+    new_leaves = []
+    for path, pool in zip(paths, leaves):
+        ax = _block_axis(path)
+        data = by_path[path]
+        if ax == 0:
+            pool = pool.at[dst].set(jnp.asarray(data[src], pool.dtype))
+        else:
+            pool = pool.at[:, dst].set(
+                jnp.asarray(data[:, src], pool.dtype)
+            )
+        new_leaves.append(pool)
+    kv.caches = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    for key, block in zip(adopt_keys, dst_blocks):
+        if not store.insert(key, block):
+            # Lost the race to a concurrent insert: give the block back.
+            kv.allocator.decref([block])
+    return len(adopt_keys)
 
 
 def trim_kv(payload: KVHandoff, store) -> Tuple[KVHandoff, int]:

@@ -243,6 +243,11 @@ class DecodeReplica(_ReplicaBase):
         self.handoffs_installed = 0
         self.handoffs_fallback = 0
         self.handoffs_trim_stale = 0  # trimmed prefix evicted pre-admit
+        self.prefills_full = 0        # prefill jobs that started at pos 0
+        self.gossip_adopts = 0        # remote prefix runs installed here
+        self.gossip_adopt_blocks = 0  # blocks those runs carried
+        self.gossip_serves = 0        # runs packed here for a peer
+        self.gossip_advertised = 0    # keys newly advertised (cumulative)
 
     # ------------------------------------------------------------ signals
     @property
@@ -346,6 +351,12 @@ class DecodeReplica(_ReplicaBase):
             # token-exact.
             total = seq.context_len
             begin = min(seq.cached_len, total - 1)
+            if begin == 0:
+                # Nothing cached at all — the whole context recomputes.
+                # This is the counter prefix gossip exists to keep at
+                # zero for shared prefixes (fleet telemetry aggregates
+                # it as handoffs.prefills_full).
+                self.prefills_full += 1
             step = self.prefill_chunk or (total - begin)
             chunks = [
                 (s, min(step, total - s)) for s in range(begin, total, step)

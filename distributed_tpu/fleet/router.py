@@ -189,7 +189,8 @@ class Router:
         return seq
 
     # ------------------------------------------------------------ placement
-    def place(self, seq: Sequence, candidates):
+    def place(self, seq: Sequence, candidates, *,
+              gossip_adoptable: bool = False):
         """Pick the decode replica for ``seq`` from ``candidates``
         (replicas with capacity): PREFIX AFFINITY first — a replica whose
         prefix store already holds the sequence's leading prompt block
@@ -199,7 +200,14 @@ class Router:
         ADMIT soonest; two warm replicas are equally warm, but the one
         with the shorter wait wins), then least in-flight, then name
         (deterministic). Without prefix caching every replica scores
-        equal affinity and this degrades to shortest-queue/least-loaded."""
+        equal affinity and this degrades to shortest-queue/least-loaded.
+
+        ``gossip_adoptable``: the fleet found a PEER advertising this
+        sequence's prefix (``fleet.gossip``), so any prefix-caching
+        replica can be made warm by adopting the remote run at placement
+        — every such replica scores warm affinity and the tie breaks by
+        queue depth, instead of the cold pool pinning all shared-prefix
+        traffic onto the one replica that prefilled first."""
         pool = list(candidates)
         if not pool:
             return None
@@ -207,6 +215,10 @@ class Router:
         def key(rep):
             holds = getattr(rep, "holds_prefix", None)
             affinity = 1 if holds is not None and holds(seq) else 0
+            if not affinity and gossip_adoptable and (
+                    getattr(getattr(rep, "kv", None), "prefix", None)
+                    is not None):
+                affinity = 1
             return (-affinity, getattr(rep, "queue_depth", rep.in_flight),
                     rep.in_flight, rep.name)
 

@@ -117,6 +117,19 @@ def summarize(events: List[dict], flight_paths=(),
             (e for e in reversed(events)
              if e["event"] == evs.BUBBLE_REPORT), None
         ),
+        # Speculation-that-pays timeline: last spec_verify aggregate,
+        # every draft sync / per-tenant k move, and the gossip traffic.
+        "spec_verify": next(
+            (e for e in reversed(events)
+             if e["event"] == evs.SPEC_VERIFY), None
+        ),
+        "draft_syncs": [e for e in events if e["event"] == evs.DRAFT_SYNC],
+        "spec_k_adjusts": [e for e in events
+                           if e["event"] == evs.SPEC_K_ADJUST],
+        "gossip_advertises": [e for e in events
+                              if e["event"] == evs.PREFIX_GOSSIP_ADVERTISE],
+        "gossip_adopts": [e for e in events
+                          if e["event"] == evs.PREFIX_GOSSIP_ADOPT],
         "flight_dumps": dumps,
     }
 
@@ -181,6 +194,41 @@ def render(summary: dict, *, tail: int = 10) -> str:
             f"  pipeline bubble: {bub.get('bubble_fraction')} idle "
             f"over {bub.get('ticks')} ticks"
         )
+    sv = summary.get("spec_verify")
+    if sv is not None:
+        lines.append(
+            f"  speculative decode: accept_rate={sv.get('accept_rate')} "
+            f"({sv.get('accepted')}/{sv.get('proposed')} over "
+            f"{sv.get('rounds')} rounds, "
+            f"{sv.get('tokens_per_dispatch')} tok/dispatch)"
+        )
+    for ds in summary.get("draft_syncs", ()):
+        lines.append(
+            f"  draft sync [{_fmt_ts(ds.get('ts'))}]: "
+            f"weights_version={ds.get('weights_version')} "
+            f"staleness={ds.get('staleness')} source={ds.get('source')}"
+        )
+    for ka in summary.get("spec_k_adjusts", ()):
+        lines.append(
+            f"  spec_k adjust [{_fmt_ts(ka.get('ts'))}]: "
+            f"tenant={ka.get('tenant')} {ka.get('old_k')} -> "
+            f"{ka.get('new_k')} (accept_ema={ka.get('accept_ema')})"
+        )
+    adv = summary.get("gossip_advertises", ())
+    adp = summary.get("gossip_adopts", ())
+    if adv or adp:
+        lines.append(
+            f"  prefix gossip: {len(adv)} advertise(s) "
+            f"({sum(int(e.get('blocks', 0)) for e in adv)} blocks), "
+            f"{len(adp)} adopt(s) "
+            f"({sum(int(e.get('blocks', 0)) for e in adp)} blocks)"
+        )
+        for e in adp:
+            lines.append(
+                f"    adopt [{_fmt_ts(e.get('ts'))}]: {e.get('source')} "
+                f"-> {e.get('replica')} ({e.get('blocks')} blocks, "
+                f"weights_version={e.get('weights_version')})"
+            )
     strag = summary["straggler"] or next(
         iter(summary["straggler_events"]), None
     )

@@ -19,6 +19,7 @@ tokens/s, train steps/s, weight-sync latency per iteration, and reward
 improving across iterations.
 """
 
+from .distill import DraftDistiller, distill_loss, pack_distill
 from .loop import PostTrainer, Rollout, pack_rollouts, rl_loss
 from .rewards import ToyPreferenceModel, length_penalized_logprob
 from . import rewards
@@ -28,6 +29,9 @@ __all__ = [
     "Rollout",
     "pack_rollouts",
     "rl_loss",
+    "DraftDistiller",
+    "distill_loss",
+    "pack_distill",
     "rewards",
     "ToyPreferenceModel",
     "length_penalized_logprob",

@@ -49,8 +49,11 @@ __all__ = [
 
 #: Scalar metadata a payload dict carries next to its ``blocks`` —
 #: exactly the ``KVHandoff`` fields (``fleet.handoff``).
+#: ``weights_version`` is the gossip staleness stamp (None on the plain
+#: prefill→decode path); meta reads use ``.get`` so a manifest written
+#: before the stamp existed still decodes.
 PAYLOAD_META = ("cached_len", "block_size", "dtype", "prefix_hashes",
-                "skip_blocks")
+                "skip_blocks", "weights_version")
 
 MANIFEST = "manifest.json"
 
@@ -72,6 +75,7 @@ def handoff_to_payload(handoff) -> dict:
         "dtype": str(handoff.dtype),
         "prefix_hashes": list(handoff.prefix_hashes),
         "skip_blocks": int(handoff.skip_blocks),
+        "weights_version": getattr(handoff, "weights_version", None),
     }
 
 
@@ -88,6 +92,7 @@ def payload_to_handoff(payload: dict):
         dtype=str(payload["dtype"]),
         prefix_hashes=tuple(payload.get("prefix_hashes", ())),
         skip_blocks=int(payload.get("skip_blocks", 0)),
+        weights_version=payload.get("weights_version"),
     )
 
 
@@ -108,7 +113,7 @@ def encode_payload(payload: dict) -> Tuple[dict, List[bytes]]:
         np.save(buf, np.ascontiguousarray(payload["blocks"][key]),
                 allow_pickle=False)
         blobs.append(buf.getvalue())
-    meta = {k: payload[k] for k in PAYLOAD_META}
+    meta = {k: payload.get(k) for k in PAYLOAD_META}
     meta["keys"] = keys
     return meta, blobs
 
@@ -126,7 +131,7 @@ def decode_payload(meta: dict, blobs: List[bytes]) -> dict:
             blocks[key] = np.load(io.BytesIO(blob), allow_pickle=False)
         except (ValueError, OSError) as e:
             raise TransportError(f"corrupt .npy block {key!r}: {e}") from e
-    out = {k: meta[k] for k in PAYLOAD_META}
+    out = {k: meta.get(k) for k in PAYLOAD_META}
     out["blocks"] = blocks
     return out
 
@@ -177,7 +182,7 @@ class ShmTransport:
                     np.ascontiguousarray(payload["blocks"][key]),
                     allow_pickle=False)
             files.append(fname)
-        manifest = {k: payload[k] for k in PAYLOAD_META}
+        manifest = {k: payload.get(k) for k in PAYLOAD_META}
         manifest["keys"] = keys
         manifest["files"] = files
         (tmp / MANIFEST).write_text(json.dumps(manifest))
@@ -203,7 +208,7 @@ class ShmTransport:
                 raise TransportError(
                     f"corrupt shm block {fname} of {path}: {e}"
                 ) from e
-        out = {k: manifest[k] for k in PAYLOAD_META}
+        out = {k: manifest.get(k) for k in PAYLOAD_META}
         out["blocks"] = blocks
         return out
 

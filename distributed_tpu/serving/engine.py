@@ -61,6 +61,7 @@ import numpy as np
 
 from ..obs import registry as obs_registry
 from ..obs import spans as obs_spans
+from ..optim import EmaBaseline
 from ..training.model import Model, _cast_for_compute
 from ..utils import event_schema as evs
 from ..utils import events as events_lib
@@ -70,6 +71,67 @@ from .scheduler import Request, Scheduler
 
 
 _M64 = (1 << 64) - 1
+
+#: Adaptive speculative-k ladder: the ONLY verify widths an adaptive
+#: engine ever dispatches (0 = draft off for that tenant, plain decode).
+#: A fixed ladder is what keeps batch churn recompile-free — at most one
+#: trace per rung of the one _verify_jit, never one per batch mix.
+SPEC_K_LADDER = (0, 2, 4, 8)
+#: Cold-start k for a tenant with no accept-rate evidence yet: explore
+#: at mid-ladder rather than assuming the draft wins (8) or loses (0).
+SPEC_K_DEFAULT = 4
+#: Per-tenant accept-rate EMA decay (optim.EmaBaseline: first update
+#: adopts outright) and the observation floor before the ladder reacts —
+#: one unlucky round must not permanently disable a good draft.
+SPEC_EMA_DECAY = 0.7
+SPEC_MIN_ROUNDS = 2
+
+
+def _ladder_k(accept_ema: float) -> int:
+    """Ladder rung for an accept-rate EMA: the break-even thresholds of
+    docs/PERF.md "When speculation pays" — below 0.25 the draft's dispatch
+    cost exceeds the verify savings at ANY k, so it switches off."""
+    if accept_ema < 0.25:
+        return 0
+    if accept_ema < 0.5:
+        return 2
+    if accept_ema < 0.75:
+        return 4
+    return 8
+
+
+def _validate_swap(ref_params, params, label: str) -> None:
+    """Hot-swap gate shared by ``Engine.update_weights`` (target and
+    draft arms) and ``fleet.ServingFleet.update_weights``: tree
+    structure, leaf shapes AND dtypes must match the served tree exactly
+    — a mismatch would silently retrace the fixed decode dispatch, so it
+    raises ``ValueError`` loudly instead."""
+    ref_paths = jax.tree_util.tree_leaves_with_path(ref_params)
+    ref_struct = jax.tree_util.tree_structure(ref_params)
+    got_struct = jax.tree_util.tree_structure(params)
+    if ref_struct != got_struct:
+        raise ValueError(
+            f"{label}: new param tree structure does not match "
+            f"the served tree: {got_struct} vs {ref_struct}"
+        )
+    for (kpath, have), want in zip(
+        ref_paths, jax.tree_util.tree_leaves(params)
+    ):
+        if tuple(have.shape) != tuple(getattr(want, "shape", ())):
+            raise ValueError(
+                f"{label}: shape mismatch at "
+                f"{jax.tree_util.keystr(kpath)}: new weights have "
+                f"{tuple(getattr(want, 'shape', ()))}, engine serves "
+                f"{tuple(have.shape)}"
+            )
+        if jnp.dtype(jnp.result_type(want)) != jnp.dtype(have.dtype):
+            raise ValueError(
+                f"{label}: dtype mismatch at "
+                f"{jax.tree_util.keystr(kpath)}: new weights are "
+                f"{jnp.result_type(want)}, engine serves {have.dtype} "
+                "(a dtype change would retrace the fixed decode "
+                "dispatch)"
+            )
 
 
 def _mix_seed(engine_seed: int, request_seed: int) -> int:
@@ -267,7 +329,11 @@ class Engine:
     position with the same per-token-index key vanilla decode would
     use). The draft must be a built LM over the same vocabulary; it
     keeps its own fully-provisioned paged cache and re-prefills fully on
-    (re-)admission.
+    (re-)admission. ``spec_k="adaptive"`` lets per-tenant accept-rate
+    EMAs pick each round's k from ``SPEC_K_LADDER`` — speculation turns
+    itself off (k=0) for tenants where the draft loses — with headroom
+    reserved at the ladder max and every width a fixed shape, so tenant
+    churn never recompiles.
     """
 
     def __init__(self, model: Model, max_slots: int, block_size: int, *,
@@ -345,7 +411,24 @@ class Engine:
         # both pools' slot ownership in lockstep, and degenerates to the
         # target cache when no draft is configured.
         self._draft = draft_model
-        self._spec_k = int(spec_k)
+        # spec_k="adaptive": per-tenant accept-rate EMAs pick each
+        # round's verify width from SPEC_K_LADDER; headroom/reservation
+        # math uses the ladder MAX so a tenant stepping up never needs
+        # blocks the admission didn't grant.
+        self._adaptive_k = spec_k == "adaptive"
+        if self._adaptive_k:
+            self._spec_k = SPEC_K_LADDER[-1]
+        elif isinstance(spec_k, str):
+            raise ValueError(
+                f"spec_k must be an int >= 2 or 'adaptive', got {spec_k!r}"
+            )
+        else:
+            self._spec_k = int(spec_k)
+        self._accept_ema = {}    # tenant -> EmaBaseline of round accepts
+        self._tenant_k = {}      # tenant -> current ladder k
+        self._tenant_rounds = {}  # tenant -> speculative rounds observed
+        self._tenant_moved = {}  # tenant -> round of its last rung move
+        self._k_adjustments = 0
         if draft_model is not None:
             if not draft_model.built:
                 raise RuntimeError("draft model not built")
@@ -368,9 +451,22 @@ class Engine:
                 dtype=draft_model.decode_dtype(),
             )
             self._kvs = _PairedKV(self.kv, self._draft_kv)
+            # Draft weights are an engine-owned snapshot too (same
+            # discipline as self._params): a DraftDistiller training the
+            # shared draft model in-process publishes through
+            # update_weights(draft_params=...), never by side effect.
+            self._draft_params = draft_model.params
+            self._draft_state = draft_model.state
         else:
             self._draft_kv = None
             self._kvs = self.kv
+            self._draft_params = None
+            self._draft_state = None
+        # Draft staleness: how many target swaps the served draft has
+        # NOT been re-synced across (0 = in sync). Acceptance-only —
+        # proposals are always verified by the live target.
+        self._draft_version = 0
+        self._draft_staleness = 0
         # Both dispatches jit once (decode shapes are fixed; prefill
         # retraces only per distinct bucketed chunk length) under the
         # model's strategy/precision scopes — same discipline as every
@@ -483,7 +579,7 @@ class Engine:
         generated token names the weights that produced it."""
         return self._weights_version
 
-    def update_weights(self, params) -> int:
+    def update_weights(self, params=None, *, draft_params=None) -> int:
         """Hot-swap the served weights WITHOUT a restart: validate the new
         tree against the live one, re-place it under the engine model's
         strategy (the ``quant.quantize_model`` quantize-on-load
@@ -507,53 +603,65 @@ class Engine:
         mismatches raise ``ValueError`` loudly. State (e.g. BatchNorm
         stats) is not swapped; serving LMs carry none, and a model that
         does should rebuild its engine.
+
+        ``draft_params``: re-sync the speculative draft's served snapshot
+        (same validation, placed under the DRAFT model's strategy) — the
+        ``rl.distill.DraftDistiller`` publish path. A target swap that
+        does NOT carry ``draft_params`` leaves the draft one version
+        staler (``draft_staleness`` in run telemetry counts the gap):
+        acceptance-only drift, never correctness, since every proposal is
+        verified by the live target. Syncing emits a ``draft_sync`` event
+        recording how stale the draft had grown.
         """
-        ref_paths = jax.tree_util.tree_leaves_with_path(self._params)
-        ref_struct = jax.tree_util.tree_structure(self._params)
-        got_struct = jax.tree_util.tree_structure(params)
-        if ref_struct != got_struct:
+        if params is None and draft_params is None:
             raise ValueError(
-                "update_weights: new param tree structure does not match "
-                f"the served tree: {got_struct} vs {ref_struct}"
+                "update_weights: pass params, draft_params, or both"
             )
-        for (kpath, have), want in zip(
-            ref_paths, jax.tree_util.tree_leaves(params)
-        ):
-            if tuple(have.shape) != tuple(getattr(want, "shape", ())):
+        if params is not None:
+            _validate_swap(self._params, params, "update_weights")
+            placed = self.model.strategy.put_params(
+                params, hints=self.model.module.sharding_hints()
+            )
+            # Block until resident: the next dispatch must read the new
+            # weights, and the latency reported by callers (the bench's
+            # weight-sync row) must cover the transfer, not enqueue it.
+            jax.block_until_ready(placed)
+            self._params = placed
+            self._weights_version += 1
+            # The staleness contract extends to the prefix store: cached
+            # blocks were computed under the OLD weights, and while
+            # in-flight sequences deliberately keep theirs (the per-token
+            # version rows record the boundary), a NEW request must not
+            # silently seed from a one-version-old prefix — flush the
+            # store's references; live sharers keep their copies alive.
+            if self.kv.prefix is not None:
+                self.kv.prefix.flush(self.kv.allocator)
+            if self._draft is not None and draft_params is None:
+                self._draft_staleness += 1
+        if draft_params is not None:
+            if self._draft is None:
                 raise ValueError(
-                    "update_weights: shape mismatch at "
-                    f"{jax.tree_util.keystr(kpath)}: new weights have "
-                    f"{tuple(getattr(want, 'shape', ()))}, engine serves "
-                    f"{tuple(have.shape)}"
+                    "update_weights: draft_params given but the engine "
+                    "has no draft model"
                 )
-            if jnp.dtype(jnp.result_type(want)) != jnp.dtype(have.dtype):
-                raise ValueError(
-                    "update_weights: dtype mismatch at "
-                    f"{jax.tree_util.keystr(kpath)}: new weights are "
-                    f"{jnp.result_type(want)}, engine serves {have.dtype} "
-                    "(a dtype change would retrace the fixed decode "
-                    "dispatch)"
-                )
-        placed = self.model.strategy.put_params(
-            params, hints=self.model.module.sharding_hints()
-        )
-        # Block until resident: the next dispatch must read the new
-        # weights, and the latency reported by callers (the bench's
-        # weight-sync row) must cover the transfer, not enqueue it.
-        jax.block_until_ready(placed)
-        self._params = placed
-        self._weights_version += 1
-        # The staleness contract extends to the prefix store: cached
-        # blocks were computed under the OLD weights, and while in-flight
-        # sequences deliberately keep theirs (the per-token version rows
-        # record the boundary), a NEW request must not silently seed from
-        # a one-version-old prefix — flush the store's references; live
-        # sharers keep their copies alive. A configured draft model is
-        # NOT swapped here: a stale draft only lowers the acceptance rate
-        # (its proposals are verified by the new target either way),
-        # never correctness — sync it out-of-band when drift hurts.
-        if self.kv.prefix is not None:
-            self.kv.prefix.flush(self.kv.allocator)
+            _validate_swap(
+                self._draft_params, draft_params,
+                "update_weights(draft_params)",
+            )
+            placed = self._draft.strategy.put_params(
+                draft_params, hints=self._draft.module.sharding_hints()
+            )
+            jax.block_until_ready(placed)
+            staleness = self._draft_staleness
+            self._draft_params = placed
+            self._draft_version = self._weights_version
+            self._draft_staleness = 0
+            events_lib.emit(
+                evs.DRAFT_SYNC,
+                weights_version=int(self._weights_version),
+                staleness=int(staleness),
+                source="update_weights",
+            )
         return self._weights_version
 
     # ------------------------------------------------------------- helpers
@@ -578,9 +686,41 @@ class Engine:
             (s, min(step, total - s)) for s in range(begin, total, step)
         ]
 
+    def _observe_accept(self, seq, frac: float) -> None:
+        """Fold one speculative round's accept fraction (accepted /
+        proposed, this slot) into its tenant's EMA and re-pick the
+        tenant's ladder rung. The rung only moves after SPEC_MIN_ROUNDS
+        observations — one cold round must not lock a tenant out — and
+        then dwells SPEC_MIN_ROUNDS more between moves (an EMA sitting
+        ON a threshold must not flap the rung every round). Each move
+        emits ``spec_k_adjust`` (rare once the EMA settles, so the
+        fsync-per-record transport is safe)."""
+        tenant = str(getattr(seq, "tenant", "default"))
+        ema = self._accept_ema.get(tenant)
+        if ema is None:
+            ema = self._accept_ema[tenant] = EmaBaseline(SPEC_EMA_DECAY)
+        ema.update(float(frac))
+        rounds = self._tenant_rounds.get(tenant, 0) + 1
+        self._tenant_rounds[tenant] = rounds
+        if rounds < SPEC_MIN_ROUNDS:
+            return
+        if rounds - self._tenant_moved.get(tenant, 0) < SPEC_MIN_ROUNDS:
+            return
+        old = self._tenant_k.get(tenant, SPEC_K_DEFAULT)
+        new = _ladder_k(float(ema.value))
+        self._tenant_k[tenant] = new
+        if new != old:
+            self._k_adjustments += 1
+            self._tenant_moved[tenant] = rounds
+            events_lib.emit(
+                evs.SPEC_K_ADJUST, tenant=tenant, old_k=int(old),
+                new_k=int(new), accept_ema=round(float(ema.value), 4),
+                rounds=int(rounds),
+            )
+
     # ---------------------------------------------------------------- run
     def run(self, requests: SequenceT, *, return_logprobs: bool = False,
-            on_decode_step=None) -> List[np.ndarray]:
+            on_decode_step=None, tenants=None) -> List[np.ndarray]:
         """Serve ``requests`` (a sequence of ``serving.Request``, or
         (prompt, max_new_tokens) pairs) to completion; returns each
         request's prompt+generated tokens in submission order —
@@ -597,11 +737,21 @@ class Engine:
         ``on_decode_step``: optional ``fn(engine, decode_step)`` hook
         called after every decode dispatch — the seam a driver uses to
         interleave control actions (e.g. ``update_weights`` mid-run, the
-        hot-swap staleness-contract tests) with a live batch."""
+        hot-swap staleness-contract tests) with a live batch.
+
+        ``tenants``: optional per-request tenant names (parallel to
+        ``requests``; default ``"default"``) — the identity the adaptive
+        spec_k accept-rate EMAs key on. The fleet router sets tenants on
+        its own sequences; this is the direct-Engine equivalent."""
         reqs = [
             r if isinstance(r, Request) else Request(r[0], r[1])
             for r in requests
         ]
+        if tenants is not None and len(tenants) != len(reqs):
+            raise ValueError(
+                f"tenants covers {len(tenants)} requests but "
+                f"{len(reqs)} were submitted"
+            )
         # Speculating engines need spec_k - 1 positions of table headroom
         # past the last committed token: the verify dispatch scatters K
         # consecutive candidate rows unconditionally, and clamping them
@@ -628,11 +778,18 @@ class Engine:
         self._sched = sched
         t0 = time.perf_counter()
         seqs = [sched.submit(r, now=0.0) for r in reqs]
-        for seq in seqs:
+        for i, seq in enumerate(seqs):
             r = seq.request
             seq.sample_seed = _mix_seed(
                 self.seed, r.seed if r.seed is not None else r.request_id
             )
+            seq.tenant = (
+                str(tenants[i]) if tenants is not None else "default"
+            )
+            # Per-request speculation ledger (lifecycle rows).
+            seq.spec_proposed = 0
+            seq.spec_accepted = 0
+            seq.spec_tokens = 0
         version_at_start = self._weights_version
         results = {}
         ttft = {}
@@ -747,7 +904,7 @@ class Engine:
                             dbuf[0, :dc] = seq.tokens[dstart:dstart + dc]
                             _, _, self._draft_kv.caches = (
                                 self._draft_prefill_fn(
-                                    self._draft.params, self._draft.state,
+                                    self._draft_params, self._draft_state,
                                     self._draft_kv.caches, dbuf,
                                     self._draft_kv.block_tables[seq.slot],
                                     np.int32(dstart), np.int32(dc - 1),
@@ -806,7 +963,29 @@ class Engine:
             ready = [s for s in ready if s.slot is not None]
             if not ready:
                 continue
+            # Round width: the static spec_k, or (adaptive) the MAX of
+            # the ready tenants' ladder rungs — one verify dispatch
+            # serves the whole batch, and each slot's acceptance walk is
+            # capped at its OWN tenant's k below. Every width is a
+            # ladder rung, so _verify_jit holds at most len(ladder)-1
+            # traces however the batch churns. kw < 2 (no draft, or
+            # every ready tenant opted out) falls through to plain
+            # decode.
+            kw = 0
+            slot_limit = None
             if self._draft is not None:
+                if self._adaptive_k:
+                    slot_limit = {
+                        id(s): self._tenant_k.get(
+                            getattr(s, "tenant", "default"),
+                            SPEC_K_DEFAULT,
+                        )
+                        for s in ready
+                    }
+                    kw = max(slot_limit.values())
+                else:
+                    kw = self._spec_k
+            if kw >= 2:
                 # ---- speculative round: draft proposes, target verifies.
                 # Candidate matrix column 0 is each slot's REAL last
                 # token; columns 1..K-1 are the draft's greedy chain.
@@ -814,7 +993,6 @@ class Engine:
                 # the host walk commits the longest run where the draft's
                 # next proposal agreed with the target's token — 1..K
                 # tokens per dispatch, bit-identical to vanilla decode.
-                kw = self._spec_k
                 ready_mask = np.zeros((self.max_slots,), bool)
                 cand = np.zeros((self.max_slots, kw), np.int32)
                 keys = np.zeros((self.max_slots, kw, 2), np.uint32)
@@ -838,7 +1016,7 @@ class Engine:
                     for j in range(1, kw):
                         prop, _, self._draft_kv.caches = (
                             self._draft_decode_fn(
-                                self._draft.params, self._draft.state,
+                                self._draft_params, self._draft_state,
                                 self._draft_kv.caches, cur, dtables,
                                 dpos, dummy_keys,
                             )
@@ -864,7 +1042,6 @@ class Engine:
                     toks = np.asarray(toks)
                 decode_steps += 1
                 spec_rounds += 1
-                spec_proposed += (kw - 1) * len(ready)
                 util = self.kv.utilization()
                 util_samples.append(util)
                 queue_samples.append(len(sched.waiting))
@@ -879,6 +1056,14 @@ class Engine:
                     "running": len(ready),
                 })
                 for seq in ready:
+                    # Adaptive: this slot commits at most its OWN
+                    # tenant's k columns (k=0 rides the round but
+                    # commits exactly column 0 — the plain-decode
+                    # token, bit-identical by the verify contract).
+                    limit = (
+                        kw if slot_limit is None
+                        else max(1, slot_limit[id(seq)])
+                    )
                     m = 0
                     while True:
                         tok = int(toks[seq.slot, m])
@@ -894,10 +1079,16 @@ class Engine:
                         # proposal there IS the token the target just
                         # produced — then column m's logits were
                         # conditioned on the true prefix.
-                        if m >= kw or int(cand[seq.slot, m]) != tok:
+                        if m >= limit or int(cand[seq.slot, m]) != tok:
                             break
                     spec_tokens += m
                     spec_accepted += m - 1
+                    spec_proposed += limit - 1
+                    seq.spec_tokens += m
+                    seq.spec_accepted += m - 1
+                    seq.spec_proposed += limit - 1
+                    if self._adaptive_k and limit >= 2:
+                        self._observe_accept(seq, (m - 1) / (limit - 1))
                     # Invariant: positions = committed rows = next write.
                     self.kv.positions[seq.slot] = seq.context_len - 1
                     self._draft_kv.positions[seq.slot] = (
@@ -1009,6 +1200,19 @@ class Engine:
                     s.token_versions[: s.request.max_new_tokens]
                 ),
                 **(
+                    {
+                        "spec_tokens": int(getattr(s, "spec_tokens", 0)),
+                        "spec_proposed": int(
+                            getattr(s, "spec_proposed", 0)
+                        ),
+                        "accept_rate": (
+                            round(s.spec_accepted / s.spec_proposed, 4)
+                            if getattr(s, "spec_proposed", 0) else None
+                        ),
+                    }
+                    if self._draft is not None else {}
+                ),
+                **(
                     {"logprobs": [
                         float(lp) for lp in
                         s.logprobs[: s.request.max_new_tokens]
@@ -1053,12 +1257,27 @@ class Engine:
             )
             tpd = spec_tokens / spec_rounds if spec_rounds else 0.0
             report["speculative"] = {
-                "k": int(self._spec_k),
+                "k": (
+                    "adaptive" if self._adaptive_k else int(self._spec_k)
+                ),
                 "rounds": int(spec_rounds),
                 "proposed": int(spec_proposed),
                 "accepted": int(spec_accepted),
                 "accept_rate": round(accept_rate, 4),
                 "tokens_per_dispatch": round(tpd, 3),
+                "draft_version": int(self._draft_version),
+                "draft_staleness": int(self._draft_staleness),
+                **(
+                    {
+                        "max_k": int(self._spec_k),
+                        "tenant_k": {
+                            t: int(k)
+                            for t, k in sorted(self._tenant_k.items())
+                        },
+                        "k_adjustments": int(self._k_adjustments),
+                    }
+                    if self._adaptive_k else {}
+                ),
             }
             obs_reg.gauge("engine/spec_accept_rate", round(accept_rate, 4))
             # One per-run aggregate (the transport fsyncs per record).

@@ -212,6 +212,22 @@ class PrefixStore:
         self.misses += len(keys) - len(found)
         return found
 
+    def keys(self) -> List[str]:
+        """Every cached chain key, LRU order (oldest first) — the gossip
+        advertise-sync snapshot. Read-only: no counter or LRU effect."""
+        return list(self._entries)
+
+    def peek_run(self, keys: List[str]) -> List[int]:
+        """Block ids for the leading run of ``keys`` present, WITHOUT
+        touching the hit/miss counters or LRU order — the gossip path's
+        probe (a peer packing blocks for export is not an admission)."""
+        found: List[int] = []
+        for k in keys:
+            if k not in self._entries:
+                break
+            found.append(self._entries[k])
+        return found
+
     def insert(self, key: str, block: int) -> bool:
         """Register ``block`` under ``key`` (False if the key is already
         cached — the existing entry wins and is LRU-refreshed; the caller

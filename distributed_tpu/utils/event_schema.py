@@ -72,6 +72,12 @@ PREFIX_CACHE_HIT = "prefix_cache_hit"
 PREFIX_EVICT = "prefix_evict"
 SPEC_VERIFY = "spec_verify"
 
+# Speculation that pays (serving/engine.py, rl/distill.py, fleet/gossip).
+DRAFT_SYNC = "draft_sync"
+SPEC_K_ADJUST = "spec_k_adjust"
+PREFIX_GOSSIP_ADVERTISE = "prefix_gossip_advertise"
+PREFIX_GOSSIP_ADOPT = "prefix_gossip_adopt"
+
 # Multi-process serving service (serve_service/).
 SERVICE_START = "service_start"
 REPLICA_SPAWN = "replica_spawn"
@@ -223,6 +229,32 @@ EVENTS: Dict[str, dict] = {
         "required": ("rounds", "proposed", "accepted"),
         "optional": ("accept_rate", "tokens_per_dispatch"),
     },
+    # Draft weights swapped in (update_weights draft_params= arm or a
+    # DraftDistiller publish); staleness = target swaps the draft missed.
+    DRAFT_SYNC: {
+        "required": ("weights_version",),
+        "optional": ("staleness", "source", "distill_loss"),
+    },
+    # A tenant's speculative depth moved between rungs of the fixed
+    # ladder {0, 2, 4, 8} — per-ADJUSTMENT, not per-round (adjustments
+    # are rare once the accept-rate EMA settles).
+    SPEC_K_ADJUST: {
+        "required": ("tenant", "old_k", "new_k"),
+        "optional": ("accept_ema", "rounds"),
+    },
+    # A replica published its PrefixStore chain-hash index — per-BATCH
+    # of newly advertised runs, stamped with the advertiser's weights
+    # version so peers never adopt stale-weights blocks.
+    PREFIX_GOSSIP_ADVERTISE: {
+        "required": ("replica", "blocks"),
+        "optional": ("weights_version", "runs"),
+    },
+    # A cold replica installed a remote prefix run instead of
+    # re-prefilling it.
+    PREFIX_GOSSIP_ADOPT: {
+        "required": ("replica", "source", "blocks"),
+        "optional": ("tokens", "weights_version", "transport"),
+    },
     SERVICE_START: {
         "required": ("decode_replicas", "prefill_replicas"),
         "optional": ("transport", "port"),
@@ -302,6 +334,8 @@ __all__ = [
     "BUDDY_REFRESH", "BUDDY_REFRESH_FAILED", "FLIGHT_DUMP",
     "METRICS_SNAPSHOT", "AUTO_SHARD_PLAN", "FLEET_REPLICA_KILLED",
     "PREFIX_CACHE_HIT", "PREFIX_EVICT", "SPEC_VERIFY",
+    "DRAFT_SYNC", "SPEC_K_ADJUST", "PREFIX_GOSSIP_ADVERTISE",
+    "PREFIX_GOSSIP_ADOPT",
     "SERVICE_START", "REPLICA_SPAWN", "STREAM_OPEN", "QUOTA_REJECT",
     "TRANSPORT_FALLBACK", "OVERLAP_REPORT", "DECODE_KERNEL_SELECTED",
     "PIPELINE_SCHEDULE_SELECTED", "BUBBLE_REPORT",

@@ -179,6 +179,20 @@ if [ -n "${TIER1_PREFIX_SMOKE:-}" ]; then
         --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
 fi
 
+# TIER1_SPEC_SMOKE=1: same idea for the speculation-that-pays stack —
+# runs the draft-distillation / adaptive-spec_k tests, the cross-replica
+# prefix-gossip tests (index, pack/adopt, transport stamp, fleet TTFT,
+# the real-process shm payload — no slow filter, ~60 s total), and the
+# bench spec smoke so distill/gossip/engine-spec changes iterate fast.
+# The full gated measurement runs via `python bench.py spec`
+# (BENCH_spec.json). NOT a tier-1 substitute.
+if [ -n "${TIER1_SPEC_SMOKE:-}" ]; then
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_distill.py \
+        tests/test_gossip.py \
+        "tests/test_bench.py::test_bench_spec_smoke" \
+        -q --durations=5 -p no:cacheprovider -p no:xdist -p no:randomly
+fi
+
 # TIER1_SERVICE_SMOKE=1: same idea for the multi-process serving
 # service — runs the framing/transport/quota units, the single-worker
 # real-process end-to-end, the router/fleet tests it builds on, and the

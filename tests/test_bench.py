@@ -228,6 +228,45 @@ def test_bench_prefix_smoke():
     assert out["workload"]["useful_tokens"] > 0
 
 
+# @slow (tier-1 budget): ~25s (two distill rounds + four fleets); the
+# distill/gossip/adaptive-k gates stay in-tier via tests/test_distill.py
+# and tests/test_gossip.py, and this smoke still runs in the
+# TIER1_SPEC_SMOKE fast path (no marker filter there) and via
+# `python bench.py spec` (BENCH_spec.json).
+@pytest.mark.slow
+def test_bench_spec_smoke():
+    """The spec mode at tiny shapes: distillation lifts accept_rate past
+    the 0.5 gate, token-exactness holds under greedy AND pinned-seed
+    sampling, the gossiping fleet adopts with zero wave re-prefills and
+    zero stale adoptions, and adaptive spec_k stays recompile-free —
+    plus the artifact schema. ``strict=False`` drops only the
+    TTFT-ordering and virtual-speedup gates (overhead-dominated
+    dispatches at these shapes); the real numbers come from
+    `python bench.py spec` (BENCH_spec.json)."""
+    out = bench.bench_spec(
+        vocab=32, num_layers=2, d_model=16, num_heads=2, max_len=64,
+        max_slots=2, block_size=8, num_prompts=6, prompt_range=(4, 10),
+        max_new=16, train_epochs=25, distill_lr=5e-2, distill_epochs=30,
+        distill_rounds=2, spec_k=4, repeats=1, strict=False,
+    )
+    assert out["unit"] == "accept_rate" and out["value"] >= 0.5
+    d = out["draft"]
+    assert d["distilled_accept_rate"] > d["baseline_accept_rate"]
+    assert d["distill_loss_last"] < d["distill_loss_first"]
+    assert out["virtual_timeline"]["tokens_per_dispatch"] > 0
+    assert out["virtual_timeline"]["speedup_vs_vanilla"] > 0
+    assert out["wall_clock"]["spec_tokens_per_sec"] > 0
+    assert out["token_exact"]["greedy"] is True
+    assert out["token_exact"]["pinned_seed"] is True
+    g = out["gossip"]
+    assert g["adoptions"] >= 1 and g["adopted_blocks"] >= 2
+    assert g["stale_rejected"] == 0 and g["wave_full_reprefills"] == 0
+    ak = out["adaptive_k"]
+    assert ak["recompile_free_across_tenant_churn"] is True
+    assert ak["verify_traces"] <= len([k for k in ak["ladder"] if k >= 2])
+    assert out["workload"]["draft_model"].startswith("lm_l1")
+
+
 # @slow (tier-1 budget, PR 17): ~7s; the closed loop stays in-tier via
 # test_rl.py::test_post_trainer_closed_loop_improves_and_syncs, and this
 # smoke still runs in the TIER1_RL_SMOKE fast path (no marker filter

@@ -1,0 +1,218 @@
+"""Draft distillation + adaptive spec_k: speculation that PAYS.
+
+A random or layer-truncated draft agrees with the target almost never,
+so speculative decoding LOSES (every rejected column wasted a draft
+dispatch). ``rl.distill.DraftDistiller`` closes the gap on the serving
+workload itself; these tests pin the three contracts that make the
+lever safe to ship:
+
+- distillation MOVES the draft (forward-KL loss decreases) and LIFTS
+  greedy acceptance, while the token stream stays exactly vanilla's
+  (rejection replays the target's token — acceptance is a throughput
+  knob, never a correctness one);
+- the publish path (``update_weights(draft_params=...)``) keeps the
+  engine's served snapshot independent of the training buffers (fit
+  DONATES its inputs), tracks staleness, and emits ``draft_sync``;
+- adaptive spec_k walks the fixed ladder {0, 2, 4, 8} per tenant with
+  bounded traces: one ``_verify_jit`` entry per rung >= 2, never a
+  recompile per mix, and a hopeless draft turns itself OFF (k=0).
+
+Tiny shapes throughout (1-core tier-1 box); the target is TRAINED first
+so its logits are sharp — untrained d_model=16 models have near-tied
+logits whose argmax flips between dispatch shapes, which makes
+acceptance measurements noise (token-exactness still holds, but these
+tests assert acceptance LEVELS).
+"""
+
+import numpy as np
+import pytest
+
+import distributed_tpu as dtpu
+from distributed_tpu.rl.distill import (
+    DraftDistiller, distill_loss, pack_distill,
+)
+from distributed_tpu.rl.loop import Rollout
+from distributed_tpu.serving import Engine, Request
+from distributed_tpu.serving.engine import SPEC_K_LADDER
+from distributed_tpu.utils import event_schema as evs
+from distributed_tpu.utils.events import read_events
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """The TARGET: 2 layers, trained on a fixed next-token pattern so
+    greedy argmax is decisive (sharp logits)."""
+    rng = np.random.default_rng(0)
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=2, d_model=16, num_heads=2, max_len=64))
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    model.build((16,))
+    xs = rng.integers(0, 32, size=(32, 16)).astype(np.int32)
+    model.fit(xs, np.roll(xs, -1, axis=1), batch_size=32, epochs=25,
+              verbose=0)
+    return model
+
+
+def _fresh_draft():
+    model = dtpu.Model(dtpu.models.transformer_lm(
+        32, num_layers=1, d_model=16, num_heads=2, max_len=64))
+    model.build((16,))
+    return model
+
+
+def _prompts(rng, n=6, lo=4, hi=10, vocab=32):
+    return [rng.integers(0, vocab, size=int(s)).astype(np.int32)
+            for s in rng.integers(lo, hi, n)]
+
+
+# ---------------------------------------------------------------- packing --
+def test_pack_distill_geometry_and_mask():
+    """x is tokens[:-1]; the mask weights exactly the positions whose
+    TARGET is a generated token; prompt predictions carry zero weight."""
+    r = Rollout(np.arange(10), 4, np.full(6, -1.5))
+    x, y = pack_distill([r], train_len=16)
+    assert x.shape == (1, 15) and y.shape == (1, 15, 3)
+    assert list(x[0, :9]) == list(range(9)) and x[0, 9:].sum() == 0
+    # targets for positions 3..8 are tokens 4..9 — the generated ones
+    assert list(np.nonzero(y[0, :, 2])[0]) == [3, 4, 5, 6, 7, 8]
+    assert np.allclose(y[0, 3:9, 1], -1.5)
+    assert list(y[0, 3:9, 0]) == [4, 5, 6, 7, 8, 9]
+    with pytest.raises(ValueError, match="logprobs"):
+        pack_distill([Rollout(np.arange(10), 4, np.zeros(2))], 16)
+    with pytest.raises(ValueError, match="train_len"):
+        pack_distill([r], train_len=8)
+
+
+def test_distill_loss_is_the_agreement_gap():
+    """Uniform draft vs uniform teacher has ZERO forward-KL gap; a draft
+    that under-weights the teacher's tokens has a positive one."""
+    loss = distill_loss()
+    r = Rollout(np.arange(8), 2, np.full(6, -float(np.log(32))))
+    _x, y = pack_distill([r], train_len=8)
+    uniform = np.zeros((1, 7, 32), np.float32)
+    assert abs(float(loss(uniform, y))) < 1e-5
+    skewed = uniform.copy()
+    skewed[..., 0] = 5.0  # mass piled on token 0, teacher tokens lose
+    assert float(loss(skewed, y)) > 1.0
+
+
+# ------------------------------------------------------------ distillation --
+def test_distiller_lifts_acceptance_token_exact(lm):
+    """The tentpole: cold truncated draft accepts almost never; two
+    collect->distill->sync rounds lift greedy acceptance past 0.5 while
+    the token stream stays exactly the vanilla engine's. The per-round
+    sync also regression-covers the fit-donation hazard: round 2's
+    collect runs the engine AFTER round 1's fit donated the draft's old
+    buffers."""
+    rng = np.random.default_rng(1)
+    prompts = _prompts(rng)
+
+    def run(engine):
+        reqs = [Request(np.asarray(p, np.int32), 20, seed=7 + i)
+                for i, p in enumerate(prompts)]
+        outs = [np.asarray(o) for o in engine.run(reqs)]
+        return outs, engine.last_run_telemetry
+
+    draft = _fresh_draft()
+    eng = Engine(lm, max_slots=4, block_size=16, max_len=64,
+                 draft_model=draft, spec_k=4)
+    _, tel = run(eng)
+    cold = tel["speculative"]["accept_rate"]
+
+    dist = DraftDistiller(eng, draft, learning_rate=5e-2)
+    rows = dist.fit(prompts, max_new_tokens=20, epochs=30, rounds=2)
+    assert len(rows) == 2
+    assert rows[0]["loss_last"] < rows[0]["loss_first"]
+
+    outs, tel = run(eng)
+    warm = tel["speculative"]["accept_rate"]
+    assert warm > 0.5, (cold, warm)
+    assert warm > cold
+    # acceptance is throughput, never correctness
+    vanilla = Engine(lm, max_slots=4, block_size=16, max_len=64)
+    outs_v, _ = run(vanilla)
+    for a, b in zip(outs, outs_v):
+        assert np.array_equal(a, b)
+    # per-request rows carry the speculation economics
+    row = tel["requests"][0]
+    assert {"spec_tokens", "spec_proposed", "accept_rate"} <= set(row)
+    assert sum(r["spec_proposed"] for r in tel["requests"]) \
+        == tel["speculative"]["proposed"]
+    assert sum(r["spec_tokens"] for r in tel["requests"]) > 0
+
+
+# ---------------------------------------------------------------- publish --
+def test_update_weights_draft_arm_staleness_and_event(lm, tmp_path,
+                                                      monkeypatch):
+    """Target-only swaps age the draft (staleness count); a draft sync
+    re-places the snapshot, resets staleness, and emits ``draft_sync``
+    recording how stale the draft had grown. Bad calls fail loud."""
+    import jax
+
+    monkeypatch.setenv("DTPU_EVENT_LOG", str(tmp_path / "ev.jsonl"))
+    draft = _fresh_draft()
+    eng = Engine(lm, max_slots=2, block_size=16, max_len=64,
+                 draft_model=draft, spec_k=2)
+    same = jax.tree_util.tree_map(lambda x: x, lm.params)
+    v = eng.update_weights(same)  # target-only: draft ages
+    assert v == 1 and eng._draft_staleness == 1
+    v = eng.update_weights(same)
+    assert v == 2 and eng._draft_staleness == 2
+    v = eng.update_weights(draft_params=draft.params)
+    assert v == 2  # draft-only sync does not bump the target version
+    assert eng._draft_staleness == 0 and eng._draft_version == 2
+    events = [e for e in read_events(tmp_path / "ev.jsonl")
+              if e["event"] == evs.DRAFT_SYNC]
+    assert events and events[-1]["staleness"] == 2
+    assert events[-1]["weights_version"] == 2
+
+    with pytest.raises(ValueError, match="params"):
+        eng.update_weights()
+    plain = Engine(lm, max_slots=2, block_size=16, max_len=64)
+    with pytest.raises(ValueError, match="no draft"):
+        plain.update_weights(draft_params=draft.params)
+
+
+# -------------------------------------------------------------- adaptive k --
+def test_adaptive_k_shuts_off_hopeless_draft(lm):
+    """A cold random draft earns accept ~0: the per-tenant EMA walks its
+    rung down to k=0 (plain decode — speculation stops paying for its
+    own dispatches) and the stream stays exactly vanilla's."""
+    rng = np.random.default_rng(2)
+    prompts = _prompts(rng, n=4)
+    reqs = [Request(np.asarray(p, np.int32), 24, seed=11 + i)
+            for i, p in enumerate(prompts)]
+    eng = Engine(lm, max_slots=4, block_size=16, max_len=64,
+                 draft_model=_fresh_draft(), spec_k="adaptive")
+    outs = [np.asarray(o) for o in eng.run(reqs)]
+    spec = eng.last_run_telemetry["speculative"]
+    assert spec["k"] == "adaptive"
+    assert spec["tenant_k"]["default"] == 0
+    assert spec["k_adjustments"] >= 1
+    vanilla = Engine(lm, max_slots=4, block_size=16, max_len=64)
+    outs_v = [np.asarray(o) for o in vanilla.run(
+        [Request(np.asarray(p, np.int32), 24, seed=11 + i)
+         for i, p in enumerate(prompts)])]
+    for a, b in zip(outs, outs_v):
+        assert np.array_equal(a, b)
+
+
+def test_adaptive_k_bounded_traces_across_tenant_churn(lm):
+    """The fixed-shape contract under adaptation: however tenants and
+    rungs churn, ``_verify_jit`` holds at most one trace per ladder rung
+    >= 2, and a second run with a different tenant mix adds ZERO new
+    traces (no recompile churn)."""
+    rng = np.random.default_rng(3)
+    eng = Engine(lm, max_slots=4, block_size=16, max_len=64,
+                 draft_model=_fresh_draft(), spec_k="adaptive")
+    prompts = _prompts(rng, n=4)
+    reqs = [Request(np.asarray(p, np.int32), 16, seed=i)
+            for i, p in enumerate(prompts)]
+    eng.run(reqs, tenants=["a", "a", "b", "b"])
+    ladder_rungs = sum(1 for k in SPEC_K_LADDER if k >= 2)
+    assert eng._verify_jit._cache_size() <= ladder_rungs
+    before = eng._verify_jit._cache_size()
+    reqs2 = [Request(np.asarray(p, np.int32), 16, seed=100 + i)
+             for i, p in enumerate(prompts)]
+    eng.run(reqs2, tenants=["b", "c", "c", "a"])
+    assert eng._verify_jit._cache_size() == before
